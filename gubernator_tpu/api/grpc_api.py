@@ -1,0 +1,87 @@
+"""gRPC service wiring for V1 and PeersV1 (hand-wired generic handlers).
+
+Service/method names match the reference exactly ("pb.gubernator.V1" and
+"pb.gubernator.PeersV1", reference gubernator.pb.go:419, peers.pb.go:164) so
+reference clients interoperate.  grpc_tools isn't available in this image, so
+instead of generated *_grpc.py stubs we register method handlers directly —
+functionally identical.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from gubernator_tpu.api import pb
+
+V1_SERVICE = "pb.gubernator.V1"
+PEERS_SERVICE = "pb.gubernator.PeersV1"
+
+
+def add_v1_servicer(server: grpc.aio.Server, servicer) -> None:
+    """servicer: async methods GetRateLimits(req, ctx), HealthCheck(req, ctx)."""
+    handlers = {
+        "GetRateLimits": grpc.unary_unary_rpc_method_handler(
+            servicer.GetRateLimits,
+            request_deserializer=pb.GetRateLimitsReq.FromString,
+            response_serializer=pb.GetRateLimitsResp.SerializeToString,
+        ),
+        "HealthCheck": grpc.unary_unary_rpc_method_handler(
+            servicer.HealthCheck,
+            request_deserializer=pb.HealthCheckReq.FromString,
+            response_serializer=pb.HealthCheckResp.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(V1_SERVICE, handlers),)
+    )
+
+
+def add_peers_servicer(server: grpc.aio.Server, servicer) -> None:
+    """servicer: async GetPeerRateLimits(req, ctx), UpdatePeerGlobals(req, ctx)."""
+    handlers = {
+        "GetPeerRateLimits": grpc.unary_unary_rpc_method_handler(
+            servicer.GetPeerRateLimits,
+            request_deserializer=pb.GetPeerRateLimitsReq.FromString,
+            response_serializer=pb.GetPeerRateLimitsResp.SerializeToString,
+        ),
+        "UpdatePeerGlobals": grpc.unary_unary_rpc_method_handler(
+            servicer.UpdatePeerGlobals,
+            request_deserializer=pb.UpdatePeerGlobalsReq.FromString,
+            response_serializer=pb.UpdatePeerGlobalsResp.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(PEERS_SERVICE, handlers),)
+    )
+
+
+class V1Stub:
+    """Client stub for the public API (reference gubernator.pb.go:375-409)."""
+
+    def __init__(self, channel):
+        self.GetRateLimits = channel.unary_unary(
+            f"/{V1_SERVICE}/GetRateLimits",
+            request_serializer=pb.GetRateLimitsReq.SerializeToString,
+            response_deserializer=pb.GetRateLimitsResp.FromString,
+        )
+        self.HealthCheck = channel.unary_unary(
+            f"/{V1_SERVICE}/HealthCheck",
+            request_serializer=pb.HealthCheckReq.SerializeToString,
+            response_deserializer=pb.HealthCheckResp.FromString,
+        )
+
+
+class PeersV1Stub:
+    """Client stub for the peer plane (reference peers.pb.go:122-155)."""
+
+    def __init__(self, channel):
+        self.GetPeerRateLimits = channel.unary_unary(
+            f"/{PEERS_SERVICE}/GetPeerRateLimits",
+            request_serializer=pb.GetPeerRateLimitsReq.SerializeToString,
+            response_deserializer=pb.GetPeerRateLimitsResp.FromString,
+        )
+        self.UpdatePeerGlobals = channel.unary_unary(
+            f"/{PEERS_SERVICE}/UpdatePeerGlobals",
+            request_serializer=pb.UpdatePeerGlobalsReq.SerializeToString,
+            response_deserializer=pb.UpdatePeerGlobalsResp.FromString,
+        )

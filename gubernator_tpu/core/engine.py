@@ -1,0 +1,369 @@
+"""The rate-limit engine: host routing + one sharded device step per window.
+
+This is the TPU-native collapse of three reference components:
+
+  * the owner's batch drain (gubernator.go:210-227) → `window_step` per shard;
+  * the consistent-hash peer routing (hash.go:80-96, gubernator.go:114) →
+    `crc32(key) % num_shards` choosing the mesh-axis shard, resolved on the
+    host while packing the window;
+  * the GLOBAL async-hits + broadcast dance (global.go:72-232) → one
+    `lax.psum` of per-slot hit deltas over the mesh axis, after which the
+    authoritative state is already resident on every shard.
+
+One call to `step()` plays the role of one 500µs batching window being shipped
+to the owner (peers.go:176-207): the host packs per-shard request lanes into
+dense arrays, the device applies them in a single jitted shard_map step, and
+the responses demux back by lane index.
+
+State layout: regular (sharded) keys live in BucketState arrays of shape
+[S, C] partitioned over the "shard" mesh axis; GLOBAL keys live in a
+replicated [G] arena whose updates flow only through the psum so replicas stay
+bit-exact.  Host-side key→slot tables (state/arena.py) are per shard.
+"""
+
+from __future__ import annotations
+
+import zlib
+from functools import partial
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gubernator_tpu.api.types import (
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    RateLimitResp,
+    millisecond_now,
+)
+from gubernator_tpu.ops import kernel
+from gubernator_tpu.ops.kernel import (
+    BucketState,
+    GlobalConfig,
+    WindowBatch,
+    WindowOutput,
+)
+from gubernator_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+from gubernator_tpu.state.arena import SlotTable
+
+
+def shard_of(key: str, num_shards: int) -> int:
+    """Map a hash key to its owning shard.
+
+    Same hash family as the reference's ring (crc32 IEEE, hash.go:41) but a
+    plain modulus: mesh shards are homogeneous and resize by re-sharding the
+    arena, so ring semantics (minimal movement on membership change) buy
+    nothing inside a mesh.
+    """
+    return zlib.crc32(key.encode("utf-8")) % num_shards
+
+
+class _PackedWindow:
+    """Host-side staging buffers for one window (numpy, reused per step)."""
+
+    def __init__(self, S: int, B: int, Bg: int, Kg: int):
+        self.slot = np.full((S, B), kernel.PAD_SLOT, dtype=np.int32)
+        self.hits = np.zeros((S, B), dtype=np.int64)
+        self.limit = np.zeros((S, B), dtype=np.int64)
+        self.duration = np.zeros((S, B), dtype=np.int64)
+        self.algo = np.zeros((S, B), dtype=np.int32)
+        self.is_init = np.zeros((S, B), dtype=bool)
+        self.gslot = np.full((S, Bg), kernel.PAD_SLOT, dtype=np.int32)
+        self.ghits = np.zeros((S, Bg), dtype=np.int64)
+        self.glimit = np.zeros((S, Bg), dtype=np.int64)
+        self.gduration = np.zeros((S, Bg), dtype=np.int64)
+        self.galgo = np.zeros((S, Bg), dtype=np.int32)
+        self.gis_init = np.zeros((S, Bg), dtype=bool)
+        self.uslot = np.zeros((Kg,), dtype=np.int32)
+        self.ulimit = np.zeros((Kg,), dtype=np.int64)
+        self.uduration = np.zeros((Kg,), dtype=np.int64)
+        self.ualgo = np.zeros((Kg,), dtype=np.int32)
+        self.rslot = np.zeros((Kg,), dtype=np.int32)
+
+    def reset(self, G: int):
+        self.slot.fill(kernel.PAD_SLOT)
+        self.gslot.fill(kernel.PAD_SLOT)
+        self.ghits.fill(0)
+        # pad config-update/reset lanes point one past the global arena → dropped
+        self.uslot.fill(G)
+        self.rslot.fill(G)
+
+
+class RateLimitEngine:
+    """Dense sharded rate-limit state + one jitted device step per window.
+
+    capacity_per_shard: slots per shard (reference default cache size is
+        50k per node, cache/lru.go:50; ours defaults to 64k per shard).
+    batch_per_shard: max regular-key request lanes per shard per window.
+    global_capacity: slots in the replicated GLOBAL arena.
+    global_batch_per_shard: max GLOBAL request lanes per shard per window.
+    """
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        capacity_per_shard: int = 65536,
+        batch_per_shard: int = 1024,
+        global_capacity: int = 4096,
+        global_batch_per_shard: int = 256,
+        max_global_updates: int = 256,
+    ):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.num_shards = int(np.prod(list(self.mesh.shape.values())))
+        self.capacity_per_shard = capacity_per_shard
+        self.batch_per_shard = batch_per_shard
+        self.global_capacity = global_capacity
+        self.global_batch_per_shard = global_batch_per_shard
+        self.max_global_updates = max_global_updates
+
+        S, C, G = self.num_shards, capacity_per_shard, global_capacity
+        shard_sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+        repl_sharding = NamedSharding(self.mesh, P())
+
+        def sharded_zeros(shape, dtype, sharding):
+            return jax.device_put(jnp.zeros(shape, dtype), sharding)
+
+        self.state = BucketState(
+            limit=sharded_zeros((S, C), jnp.int64, shard_sharding),
+            duration=sharded_zeros((S, C), jnp.int64, shard_sharding),
+            remaining=sharded_zeros((S, C), jnp.int64, shard_sharding),
+            tstamp=sharded_zeros((S, C), jnp.int64, shard_sharding),
+            expire=sharded_zeros((S, C), jnp.int64, shard_sharding),
+            algo=sharded_zeros((S, C), jnp.int32, shard_sharding),
+        )
+        self.gstate = BucketState(
+            limit=sharded_zeros((G,), jnp.int64, repl_sharding),
+            duration=sharded_zeros((G,), jnp.int64, repl_sharding),
+            remaining=sharded_zeros((G,), jnp.int64, repl_sharding),
+            tstamp=sharded_zeros((G,), jnp.int64, repl_sharding),
+            expire=sharded_zeros((G,), jnp.int64, repl_sharding),
+            algo=sharded_zeros((G,), jnp.int32, repl_sharding),
+        )
+        self.gcfg = GlobalConfig(
+            limit=sharded_zeros((G,), jnp.int64, repl_sharding),
+            duration=sharded_zeros((G,), jnp.int64, repl_sharding),
+            algo=sharded_zeros((G,), jnp.int32, repl_sharding),
+        )
+
+        self.tables = [SlotTable(C) for _ in range(S)]
+        self.gtable = SlotTable(G)
+        self._buf = _PackedWindow(S, batch_per_shard, global_batch_per_shard, max_global_updates)
+        self._step_fn = self._build_step()
+        self.windows_processed = 0
+        self.decisions_processed = 0
+
+    # ------------------------------------------------------------------ device
+
+    def _build_step(self):
+        mesh = self.mesh
+
+        def shard_fn(state, gstate, gcfg, batch, gbatch, upd, now):
+            # Block shapes inside shard_map: state [1, C]; batch [1, B];
+            # gstate/gcfg [G] (replicated); upd [Kg] (replicated).
+            st = BucketState(*jax.tree.map(lambda a: a[0], state))
+            bt = WindowBatch(*jax.tree.map(lambda a: a[0], batch))
+            new_st, out = kernel.window_step(st, bt, now)
+
+            # Apply host-issued GLOBAL slot (re)configurations.  The config
+            # write refreshes limit/duration/algorithm from the latest request
+            # each window (the reference owner applies the config carried on
+            # each aggregated request, global.go:115-153); the state reset
+            # (expire=0 reads as never-initialized) happens only for lanes the
+            # host just (re)allocated.
+            uslot, ulimit, uduration, ualgo, rslot = upd
+            gcfg = GlobalConfig(
+                limit=gcfg.limit.at[uslot].set(ulimit, mode="drop"),
+                duration=gcfg.duration.at[uslot].set(uduration, mode="drop"),
+                algo=gcfg.algo.at[uslot].set(ualgo, mode="drop"),
+            )
+            gstate = gstate._replace(
+                expire=gstate.expire.at[rslot].set(jnp.int64(0), mode="drop")
+            )
+
+            gb = WindowBatch(*jax.tree.map(lambda a: a[0], gbatch))
+            gout = kernel.global_read(gstate, gb, now)
+            delta = kernel.global_accumulate(jnp.zeros_like(gstate.remaining), gb)
+            # The whole GLOBAL reconciliation — the reference's async hit send
+            # plus owner broadcast (global.go:72-232) — is this one collective.
+            summed = lax.psum(delta, SHARD_AXIS)
+            new_g = kernel.global_apply(gstate, gcfg, summed, now)
+
+            expand = lambda a: a[None]
+            return (
+                BucketState(*jax.tree.map(expand, new_st)),
+                WindowOutput(*jax.tree.map(expand, out)),
+                new_g,
+                gcfg,
+                WindowOutput(*jax.tree.map(expand, gout)),
+            )
+
+        sharded = jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(
+                jax.tree.map(lambda _: P(SHARD_AXIS), self.state),
+                jax.tree.map(lambda _: P(), self.gstate),
+                jax.tree.map(lambda _: P(), self.gcfg),
+                WindowBatch(*[P(SHARD_AXIS)] * 6),
+                WindowBatch(*[P(SHARD_AXIS)] * 6),
+                (P(), P(), P(), P(), P()),
+                P(),
+            ),
+            out_specs=(
+                jax.tree.map(lambda _: P(SHARD_AXIS), self.state),
+                WindowOutput(*[P(SHARD_AXIS)] * 4),
+                jax.tree.map(lambda _: P(), self.gstate),
+                jax.tree.map(lambda _: P(), self.gcfg),
+                WindowOutput(*[P(SHARD_AXIS)] * 4),
+            ),
+        )
+        return jax.jit(sharded, donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------- host
+
+    def step(
+        self, requests: Sequence[RateLimitReq], now: Optional[int] = None
+    ) -> List[RateLimitResp]:
+        """Process one window of requests synchronously.
+
+        Caller must respect the window caps (use `process` for auto-chunking):
+        per-shard regular lanes <= batch_per_shard, per-shard GLOBAL lanes <=
+        global_batch_per_shard, distinct GLOBAL keys <= max_global_updates.
+        """
+        if now is None:
+            now = millisecond_now()
+        S = self.num_shards
+        buf = self._buf
+        buf.reset(self.global_capacity)
+
+        reg_fill = [0] * S
+        glob_fill = [0] * S
+        # slot -> (limit, duration, algo): latest request's config wins within
+        # the window (deduped host-side — a device scatter with duplicate
+        # indices has no ordering guarantee)
+        gcfg_upd = {}
+        greset = []
+        # (shard, lane, is_global) per request, for demux
+        lanes: List[tuple] = []
+
+        for r in requests:
+            key = r.hash_key()
+            s = shard_of(key, S)
+            if r.behavior == Behavior.GLOBAL:
+                slot, is_init = self.gtable.lookup(key, now, r.duration)
+                gcfg_upd[slot] = (r.limit, r.duration, r.algorithm)
+                if is_init:
+                    greset.append(slot)
+                lane = glob_fill[s]
+                glob_fill[s] += 1
+                buf.gslot[s, lane] = slot
+                buf.ghits[s, lane] = r.hits
+                buf.glimit[s, lane] = r.limit
+                buf.gduration[s, lane] = r.duration
+                buf.galgo[s, lane] = r.algorithm
+                buf.gis_init[s, lane] = is_init
+                lanes.append((s, lane, True))
+            else:
+                slot, is_init = self.tables[s].lookup(key, now, r.duration)
+                lane = reg_fill[s]
+                reg_fill[s] += 1
+                buf.slot[s, lane] = slot
+                buf.hits[s, lane] = r.hits
+                buf.limit[s, lane] = r.limit
+                buf.duration[s, lane] = r.duration
+                buf.algo[s, lane] = r.algorithm
+                buf.is_init[s, lane] = is_init
+                lanes.append((s, lane, False))
+
+        for i, (slot, cfg) in enumerate(gcfg_upd.items()):
+            buf.uslot[i] = slot
+            buf.ulimit[i], buf.uduration[i], buf.ualgo[i] = cfg
+        for i, slot in enumerate(greset):
+            buf.rslot[i] = slot
+
+        batch = WindowBatch(
+            slot=buf.slot, hits=buf.hits, limit=buf.limit,
+            duration=buf.duration, algo=buf.algo, is_init=buf.is_init,
+        )
+        gbatch = WindowBatch(
+            slot=buf.gslot, hits=buf.ghits, limit=buf.glimit,
+            duration=buf.gduration, algo=buf.galgo, is_init=buf.gis_init,
+        )
+        upd = (buf.uslot, buf.ulimit, buf.uduration, buf.ualgo, buf.rslot)
+
+        self.state, out, self.gstate, self.gcfg, gout = self._step_fn(
+            self.state, self.gstate, self.gcfg, batch, gbatch, upd,
+            jnp.int64(now),
+        )
+        out = jax.device_get(out)
+        gout = jax.device_get(gout)
+
+        self.windows_processed += 1
+        self.decisions_processed += len(requests)
+
+        responses = []
+        for s, lane, is_global in lanes:
+            o = gout if is_global else out
+            responses.append(
+                RateLimitResp(
+                    status=int(o.status[s, lane]),
+                    limit=int(o.limit[s, lane]),
+                    remaining=int(o.remaining[s, lane]),
+                    reset_time=int(o.reset_time[s, lane]),
+                )
+            )
+        return responses
+
+    def process(
+        self, requests: Sequence[RateLimitReq], now: Optional[int] = None
+    ) -> List[RateLimitResp]:
+        """step() with automatic chunking when a window overflows the caps."""
+        S = self.num_shards
+        out: List[RateLimitResp] = []
+        chunk: List[RateLimitReq] = []
+        reg_fill = [0] * S
+        glob_fill = [0] * S
+        gkeys: set = set()
+        for r in requests:
+            key = r.hash_key()
+            s = shard_of(key, S)
+            g = r.behavior == Behavior.GLOBAL
+            new_gkey = 1 if (g and key not in gkeys) else 0
+            over = (
+                (g and glob_fill[s] + 1 > self.global_batch_per_shard)
+                or ((not g) and reg_fill[s] + 1 > self.batch_per_shard)
+                or (len(gkeys) + new_gkey > self.max_global_updates)
+            )
+            if over:
+                out.extend(self.step(chunk, now))
+                chunk = []
+                reg_fill = [0] * S
+                glob_fill = [0] * S
+                gkeys = set()
+            chunk.append(r)
+            if g:
+                glob_fill[s] += 1
+                gkeys.add(key)
+            else:
+                reg_fill[s] += 1
+        if chunk:
+            out.extend(self.step(chunk, now))
+        return out
+
+    # ---------------------------------------------------------------- metrics
+
+    @property
+    def cache_size(self) -> int:
+        return sum(len(t) for t in self.tables) + len(self.gtable)
+
+    @property
+    def cache_hits(self) -> int:
+        return sum(t.hits for t in self.tables) + self.gtable.hits
+
+    @property
+    def cache_misses(self) -> int:
+        return sum(t.misses for t in self.tables) + self.gtable.misses

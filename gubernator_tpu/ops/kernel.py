@@ -1,0 +1,458 @@
+"""The rate-limit window kernel: both bucket algorithms over dense SoA state.
+
+This module is the TPU-native replacement for the reference's hot loop — the
+`tokenBucket`/`leakyBucket` functions applied one key at a time under a global
+cache mutex (reference algorithms.go:24-186, gubernator.go:236-251).  Here one
+*window* of requests (the reference's 500µs BATCHING window, peers.go:143-172)
+is evaluated as a single fused XLA computation over a batch:
+
+  * State is a structure-of-arrays arena in device memory (`BucketState`),
+    replacing the map+linked-list LRU (reference cache/lru.go:30-96).  A slot
+    index replaces the string key; the host keeps the key→slot table
+    (state/arena.py).
+  * Every request in the window is routed to a slot.  Requests to *different*
+    slots are data-parallel.  Requests to the *same* slot must observe
+    sequential semantics (request N+1 sees N's decrement — the reference gets
+    this from the cache mutex), which we reproduce with a sorted
+    segment-replay: sort the window by slot, then run `max_duplicates` rounds
+    of a fully-vectorized transition, each round applying the p-th request of
+    every segment simultaneously.  A window of unique keys converges in one
+    round; only hot-key duplicates add rounds.
+  * Lazy TTL expiry (reference cache/lru.go:110-114: entry is a miss when
+    `expireAt < now`) is evaluated *inside* the kernel, so the host table
+    never needs to know whether an entry is live.
+
+Branch semantics are reproduced exactly — including the subtle ones:
+no-mutation-on-over-ask (algorithms.go:57-62,143-148), hits==0 read-only
+(algorithms.go:46-49,150-153), exact-drain returns UNDER_LIMIT
+(algorithms.go:51-55,136-141), OVER_LIMIT *is* stored on first-request
+over-ask (algorithms.go:77-83,176-181), leaky's rate computed from the stored
+duration but the *request's* limit (algorithms.go:107), the leaky timestamp
+advancing even when the request is rejected (algorithms.go:118-121,143-148),
+and repeated leak application when zero-hit reads interleave (a consequence of
+algorithms.go:110-121).
+
+Deliberate divergences from the reference (see SURVEY.md §7 "reference bugs
+not to replicate"):
+  * algorithm switch mid-stream resets the entry and re-runs it under the
+    *requested* algorithm (the reference falls back to tokenBucket from
+    leakyBucket, algorithms.go:100-104);
+  * successful leaky decrement extends expiry to now + duration (the reference
+    computes `now * duration`, algorithms.go:157);
+  * leaky `rate` is clamped to ≥1ms (the reference divides by zero when
+    limit > duration, algorithms.go:107-111 — a Go runtime panic).
+
+All rate quantities are int64 (proto contract, gubernator.proto:104-117) and
+timestamps are unix-epoch milliseconds (cache/lru.go:99-101) passed in as the
+per-window `now` scalar — one timestamp per window instead of one per request.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Algorithm / status constants mirrored from the proto enums
+# (proto/gubernator.proto:56-61,126-129).  Kept as plain ints so they can be
+# used inside jit without host lookups.
+TOKEN_BUCKET = 0
+LEAKY_BUCKET = 1
+UNDER_LIMIT = 0
+OVER_LIMIT = 1
+
+# Slot value marking a padded (unused) lane of a window batch.
+PAD_SLOT = -1
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+
+class BucketState(NamedTuple):
+    """Dense SoA arena state, one row per key slot.
+
+    Replaces the reference's cacheRecord {value, expireAt} where value is
+    either a *RateLimitResp (token) or a LeakyBucket (leaky)
+    (cache/lru.go:42-46, algorithms.go:70-75,89-94,162-167):
+
+      limit/duration: the stored config, captured at (re)initialization.
+      remaining:      tokens left in the bucket.
+      tstamp:         token: the bucket's reset_time (== window end, ms epoch);
+                      leaky: the last-leak TimeStamp.
+      expire:         cache-entry expiry (ms epoch).  0 == never initialized,
+                      and `expire < now` == expired, both of which read as a
+                      cache miss (lru.go:110-114).
+      algo:           which algorithm initialized this slot; a mismatch with
+                      the request's algorithm reads as a miss.
+    """
+
+    limit: jax.Array  # i64[C]
+    duration: jax.Array  # i64[C]
+    remaining: jax.Array  # i64[C]
+    tstamp: jax.Array  # i64[C]
+    expire: jax.Array  # i64[C]
+    algo: jax.Array  # i32[C]
+
+    @classmethod
+    def zeros(cls, capacity: int) -> "BucketState":
+        z64 = jnp.zeros((capacity,), dtype=I64)
+        return cls(
+            limit=z64,
+            duration=z64,
+            remaining=z64,
+            tstamp=z64,
+            expire=z64,
+            algo=jnp.zeros((capacity,), dtype=I32),
+        )
+
+
+class WindowBatch(NamedTuple):
+    """One batching window's requests, routed to slots and padded to length B."""
+
+    slot: jax.Array  # i32[B], PAD_SLOT for unused lanes
+    hits: jax.Array  # i64[B]
+    limit: jax.Array  # i64[B]
+    duration: jax.Array  # i64[B]
+    algo: jax.Array  # i32[B]
+    is_init: jax.Array  # bool[B]: host just allocated this slot for a new key
+
+    @classmethod
+    def pad(cls, size: int) -> "WindowBatch":
+        return cls(
+            slot=jnp.full((size,), PAD_SLOT, dtype=I32),
+            hits=jnp.zeros((size,), dtype=I64),
+            limit=jnp.zeros((size,), dtype=I64),
+            duration=jnp.zeros((size,), dtype=I64),
+            algo=jnp.zeros((size,), dtype=I32),
+            is_init=jnp.zeros((size,), dtype=jnp.bool_),
+        )
+
+
+class WindowOutput(NamedTuple):
+    """Per-request responses (RateLimitResp fields, proto:131-143)."""
+
+    status: jax.Array  # i32[B]
+    limit: jax.Array  # i64[B]
+    remaining: jax.Array  # i64[B]
+    reset_time: jax.Array  # i64[B]
+
+
+class _Reg(NamedTuple):
+    """A segment's live bucket state during replay (same fields as BucketState)."""
+
+    limit: jax.Array
+    duration: jax.Array
+    remaining: jax.Array
+    tstamp: jax.Array
+    expire: jax.Array
+    algo: jax.Array
+
+
+def _chain(pairs, default):
+    """First-match-wins selection, mirroring the reference's if/else ladders."""
+    out = default
+    for cond, val in reversed(pairs):
+        out = jnp.where(cond, val, out)
+    return out
+
+
+def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh):
+    """One request applied to one bucket, vectorized over the batch dimension.
+
+    `fresh` marks lanes that must take the cache-miss/init path (new slot,
+    expired entry, or algorithm switch).  Returns (new_reg, WindowOutput).
+
+    The branch ladders reproduce algorithms.go:24-85 (token) and
+    algorithms.go:88-186 (leaky) exactly; see the module docstring for the
+    three documented divergences.
+    """
+    L, D, R, T, E, A = reg
+    h = hits
+    is_token = req_algo == TOKEN_BUCKET
+
+    # ---- init path (cache miss): algorithms.go:68-84 / :161-185 ----
+    over_init = h > req_limit
+    init_R = jnp.where(over_init, jnp.int64(0), req_limit - h)
+    init_status = jnp.where(over_init, OVER_LIMIT, UNDER_LIMIT).astype(I32)
+    # token stores reset_time = now+duration (:69-74); leaky stores
+    # TimeStamp = now (:166) and its init response has ResetTime 0 (:173).
+    init_T = jnp.where(is_token, now + req_duration, now)
+    init_reg = _Reg(
+        limit=req_limit,
+        duration=req_duration,
+        remaining=init_R,
+        tstamp=init_T,
+        expire=now + req_duration,
+        algo=req_algo,
+    )
+    init_out = WindowOutput(
+        status=init_status,
+        limit=req_limit,
+        remaining=init_R,
+        reset_time=jnp.where(is_token, now + req_duration, jnp.int64(0)),
+    )
+
+    # ---- token bucket hit path: algorithms.go:40-65 ----
+    tb_at_zero = R == 0  # :41-44 -> OVER, remaining 0
+    tb_read = h == 0  # :47-49 -> read-only
+    tb_drain = h == R  # :52-55 -> UNDER, remaining -> 0
+    tb_over = h > R  # :58-62 -> OVER, state NOT mutated
+    t_status = _chain(
+        [(tb_at_zero, OVER_LIMIT), (tb_read, UNDER_LIMIT), (tb_drain, UNDER_LIMIT), (tb_over, OVER_LIMIT)],
+        UNDER_LIMIT,
+    ).astype(I32)
+    t_resp_R = _chain(
+        [(tb_at_zero, jnp.int64(0)), (tb_read, R), (tb_drain, jnp.int64(0)), (tb_over, R)],
+        R - h,
+    )
+    t_new_R = _chain(
+        [(tb_at_zero, R), (tb_read, R), (tb_drain, jnp.int64(0)), (tb_over, R)],
+        R - h,
+    )
+    token_reg = _Reg(limit=L, duration=D, remaining=t_new_R, tstamp=T, expire=E, algo=A)
+    # all token hit responses carry the stored limit and stored reset_time
+    token_out = WindowOutput(status=t_status, limit=L, remaining=t_resp_R, reset_time=T)
+
+    # ---- leaky bucket hit path: algorithms.go:107-158 ----
+    # rate = stored duration / REQUEST limit (:107) — a reference quirk we
+    # keep; clamped to >=1ms where the reference would panic on a zero rate.
+    rate = D // jnp.maximum(req_limit, jnp.int64(1))
+    rate = jnp.maximum(rate, jnp.int64(1))
+    leak = (now - T) // rate  # :110-111
+    R2 = jnp.minimum(R + leak, L)  # :113-115 clamp to stored limit
+    T2 = jnp.where(h != 0, now, T)  # :118-121 ts advances only on hits
+    lb_at_zero = R2 == 0  # :130-134 -> OVER, reset now+rate
+    lb_drain = h == R2  # :136-141 -> UNDER, remaining -> 0, reset 0
+    lb_over = h > R2  # :143-148 -> OVER, no decrement, reset now+rate
+    lb_read = h == 0  # :150-153 -> read-only
+    l_status = _chain(
+        [(lb_at_zero, OVER_LIMIT), (lb_drain, UNDER_LIMIT), (lb_over, OVER_LIMIT), (lb_read, UNDER_LIMIT)],
+        UNDER_LIMIT,
+    ).astype(I32)
+    l_resp_R = _chain(
+        [(lb_at_zero, jnp.int64(0)), (lb_drain, jnp.int64(0)), (lb_over, R2), (lb_read, R2)],
+        R2 - h,
+    )
+    l_reset = _chain(
+        [(lb_at_zero, now + rate), (lb_drain, jnp.int64(0)), (lb_over, now + rate), (lb_read, jnp.int64(0))],
+        jnp.int64(0),
+    )
+    l_new_R = _chain(
+        [(lb_at_zero, R2), (lb_drain, jnp.int64(0)), (lb_over, R2), (lb_read, R2)],
+        R2 - h,
+    )
+    # expiry extends only on a successful decrement (:155-157, with the
+    # now*duration bug corrected to now+duration using the request's duration)
+    l_hit = ~(lb_at_zero | lb_drain | lb_over | lb_read)
+    l_new_E = jnp.where(l_hit, now + req_duration, E)
+    leaky_reg = _Reg(limit=L, duration=D, remaining=l_new_R, tstamp=T2, expire=l_new_E, algo=A)
+    leaky_out = WindowOutput(status=l_status, limit=L, remaining=l_resp_R, reset_time=l_reset)
+
+    # ---- combine: requested algorithm picks the hit path (non-fresh lanes
+    # are guaranteed to have stored algo == requested algo) ----
+    hit_reg = jax.tree.map(lambda t, l: jnp.where(is_token, t, l), token_reg, leaky_reg)
+    hit_out = jax.tree.map(lambda t, l: jnp.where(is_token, t, l), token_out, leaky_out)
+
+    new_reg = jax.tree.map(lambda i, hh: jnp.where(fresh, i, hh), init_reg, hit_reg)
+    out = jax.tree.map(lambda i, hh: jnp.where(fresh, i, hh), init_out, hit_out)
+    return _Reg(*new_reg), WindowOutput(*out)
+
+
+def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketState, WindowOutput]:
+    """Apply one window of requests to the arena; returns (new_state, responses).
+
+    Equivalent to the owning node draining one batched GetPeerRateLimits RPC
+    item-by-item under the cache mutex (gubernator.go:210-227,236-251), but as
+    one device computation.  Responses are positionally aligned with the batch
+    (the reference demuxes by index, peers.go:204-207).
+    """
+    B = batch.slot.shape[0]
+    C = state.limit.shape[0]
+    now = jnp.asarray(now, dtype=I64)
+
+    valid = batch.slot >= 0
+    # Sort by slot (stable → arrival order preserved within a slot); pads last.
+    sort_key = jnp.where(valid, batch.slot, jnp.int32(2**31 - 1))
+    order = jnp.argsort(sort_key)
+    s_slot = sort_key[order]
+    s_valid = valid[order]
+    s_hits = batch.hits[order]
+    s_limit = batch.limit[order]
+    s_duration = batch.duration[order]
+    s_algo = batch.algo[order]
+    s_init = batch.is_init[order]
+
+    idx = jnp.arange(B, dtype=I32)
+    seg_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), s_slot[1:] != s_slot[:-1]])
+    seg_start_idx = lax.cummax(jnp.where(seg_start, idx, jnp.int32(0)))
+    pos = idx - seg_start_idx
+
+    # Registers: the live state of each segment's bucket, stored at the
+    # segment-start position.  Initialized from the arena.
+    g = jnp.clip(s_slot, 0, C - 1)
+    cur = _Reg(
+        limit=state.limit[g],
+        duration=state.duration[g],
+        remaining=state.remaining[g],
+        tstamp=state.tstamp[g],
+        expire=state.expire[g],
+        algo=state.algo[g],
+    )
+    # Miss conditions known before replay: fresh host allocation or lazy TTL
+    # expiry (lru.go:110: expireAt < now).  Algorithm switches are detected
+    # per-round against the live register.
+    cur_fresh = s_init | (cur.expire < now)
+
+    # zeros_like keeps the buffers device-varying under shard_map (each shard
+    # owns its own response lanes) — plain jnp.zeros would be replicated and
+    # trip the while_loop carry vma check.
+    outs = WindowOutput(
+        status=jnp.zeros_like(s_algo),
+        limit=jnp.zeros_like(s_hits),
+        remaining=jnp.zeros_like(s_hits),
+        reset_time=jnp.zeros_like(s_hits),
+    )
+
+    max_pos = jnp.max(jnp.where(s_valid, pos, jnp.int32(0)))
+
+    def round_body(carry):
+        p, cur, cur_fresh, outs = carry
+        active = (pos == p) & s_valid
+        reg = jax.tree.map(lambda a: a[seg_start_idx], cur)
+        reg = _Reg(*reg)
+        # fresh: segment-level miss (expired/new at window start), an
+        # algorithm switch against the live register, or THIS lane having
+        # re-allocated the slot (capacity eviction can recycle a slot to a
+        # different key mid-window — its first lane must re-init, not
+        # inherit the previous tenant's register).
+        fresh = cur_fresh[seg_start_idx] | (s_algo != reg.algo) | s_init
+        new_reg, resp = transition(reg, s_hits, s_limit, s_duration, s_algo, now, fresh)
+        # One active lane per segment → scatter back is collision-free.
+        widx = jnp.where(active, seg_start_idx, jnp.int32(B))
+        cur = _Reg(*jax.tree.map(
+            lambda c, n: c.at[widx].set(n, mode="drop"), cur, new_reg
+        ))
+        cur_fresh = cur_fresh.at[widx].set(False, mode="drop")
+        outs = WindowOutput(*jax.tree.map(
+            lambda o, r: jnp.where(active, r, o), outs, resp
+        ))
+        return p + 1, cur, cur_fresh, outs
+
+    def round_cond(carry):
+        p = carry[0]
+        return p <= max_pos
+
+    _, cur, _, outs = lax.while_loop(
+        round_cond, round_body, (jnp.int32(0), cur, cur_fresh, outs)
+    )
+
+    # Commit final segment registers back to the arena (one write per touched
+    # slot — the window's net effect, like the mutex-serialized mutations).
+    wslot = jnp.where(seg_start & s_valid, s_slot, jnp.int32(C))
+    new_state = BucketState(
+        limit=state.limit.at[wslot].set(cur.limit, mode="drop"),
+        duration=state.duration.at[wslot].set(cur.duration, mode="drop"),
+        remaining=state.remaining.at[wslot].set(cur.remaining, mode="drop"),
+        tstamp=state.tstamp.at[wslot].set(cur.tstamp, mode="drop"),
+        expire=state.expire.at[wslot].set(cur.expire, mode="drop"),
+        algo=state.algo.at[wslot].set(cur.algo, mode="drop"),
+    )
+
+    # Un-sort responses back to arrival order.
+    unsorted = WindowOutput(*jax.tree.map(
+        lambda o: jnp.zeros_like(o).at[order].set(o), outs
+    ))
+    return new_state, unsorted
+
+
+def global_read(state: BucketState, batch: WindowBatch, now) -> WindowOutput:
+    """Answer GLOBAL-behavior requests from the local replica without mutating it.
+
+    Mirrors the non-owner fast path (gubernator.go:173-195): a cached entry is
+    returned as-is (hits are NOT applied locally — they reconcile via the
+    window psum, see global_apply); a miss is answered as-if-initialized
+    (the reference bootstraps its local cache the same way, :189-193 — since
+    reads never decrement, recomputing limit-hits each time is
+    response-identical while keeping replicas bit-exact across shards).
+    """
+    C = state.limit.shape[0]
+    now = jnp.asarray(now, dtype=I64)
+    g = jnp.clip(batch.slot, 0, C - 1)
+    reg = _Reg(
+        limit=state.limit[g],
+        duration=state.duration[g],
+        remaining=state.remaining[g],
+        tstamp=state.tstamp[g],
+        expire=state.expire[g],
+        algo=state.algo[g],
+    )
+    fresh = batch.is_init | (reg.expire < now) | (batch.algo != reg.algo)
+    # A cached read is the hit path with hits=0 (the cached status the owner
+    # would broadcast, global.go:199-203 → getRateLimit with Hits cleared);
+    # a miss is the init path with the request's hits.
+    read_hits = jnp.where(fresh, batch.hits, jnp.int64(0))
+    _, out = transition(reg, read_hits, batch.limit, batch.duration, batch.algo, now, fresh)
+    return out
+
+
+def global_accumulate(delta: jax.Array, batch: WindowBatch) -> jax.Array:
+    """Scatter-add this shard's GLOBAL hits into the per-slot delta array.
+
+    The device-side analog of the reference's hit aggregation map
+    (global.go:81-86: `hits[key].Hits += r.Hits`).
+    """
+    idx = jnp.where(batch.slot >= 0, batch.slot, delta.shape[0])
+    return delta.at[idx].add(batch.hits, mode="drop")
+
+
+class GlobalConfig(NamedTuple):
+    """Replicated per-slot config for GLOBAL limits (host-written at allocation).
+
+    The aggregate-apply step needs limit/duration/algorithm per slot; the
+    reference carries these on the queued RateLimitReq it sends to the owner
+    (global.go:115-153) — here they are resident device state.
+    """
+
+    limit: jax.Array  # i64[G]
+    duration: jax.Array  # i64[G]
+    algo: jax.Array  # i32[G]
+
+    @classmethod
+    def zeros(cls, capacity: int) -> "GlobalConfig":
+        return cls(
+            limit=jnp.zeros((capacity,), I64),
+            duration=jnp.zeros((capacity,), I64),
+            algo=jnp.zeros((capacity,), I32),
+        )
+
+
+def global_apply(state: BucketState, cfg: GlobalConfig, summed_hits: jax.Array, now
+                 ) -> BucketState:
+    """Apply psum'd GLOBAL hit totals to the replicated arena.
+
+    Every shard runs this on identical inputs (summed_hits is the psum over
+    the mesh axis), so replicas stay bit-exact — this one collective replaces
+    both the async hit send (global.go:115-156) and the owner's status
+    broadcast (global.go:193-232): after it runs, the authoritative state is
+    already resident on every shard.
+
+    Matches the owner's application of the aggregated request: the reference
+    sums hits per key and applies the sum as one request through the normal
+    algorithm (global.go:81-86 → gubernator.go:218-226).
+    """
+    now = jnp.asarray(now, dtype=I64)
+    reg = _Reg(
+        limit=state.limit,
+        duration=state.duration,
+        remaining=state.remaining,
+        tstamp=state.tstamp,
+        expire=state.expire,
+        algo=state.algo,
+    )
+    fresh = (reg.expire < now) | (cfg.algo != reg.algo)
+    new_reg, _ = transition(reg, summed_hits, cfg.limit, cfg.duration, cfg.algo, now, fresh)
+    touched = summed_hits != 0
+    merged = jax.tree.map(lambda n, o: jnp.where(touched, n, o), new_reg, reg)
+    return BucketState(*merged)

@@ -1,0 +1,25 @@
+"""Device mesh construction for keyspace sharding.
+
+The reference partitions its keyspace over a consistent-hash ring of Go
+processes (hash.go:28-96); here the partition axis is a 1D `jax.sharding.Mesh`
+named "shard" — one shard per chip, state placed with NamedSharding so the
+per-shard blocks live in each chip's HBM and the GLOBAL reconciliation rides
+ICI collectives instead of gRPC.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A 1D mesh over the given (default: all) devices, axis name "shard"."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))

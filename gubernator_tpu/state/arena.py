@@ -1,0 +1,85 @@
+"""Host-side key→slot management for the device state arenas.
+
+The reference's cache is a map + doubly-linked LRU list holding Go objects
+(cache/lru.go:30-96).  Here the *values* live on the device as dense SoA
+arrays (ops/kernel.py BucketState) and the host keeps only the key→slot
+mapping, LRU order, and hit/miss stats.  Responsibilities are split:
+
+  host (this module):  which slot a key occupies, capacity eviction
+                       (evict-oldest-on-overflow, lru.go:92-94), LRU touch on
+                       access (lru.go:116), hit/miss counters (lru.go:112-119).
+  device (kernel):     the actual bucket values, and lazy TTL expiry
+                       (lru.go:110-114) — an expired slot re-initializes
+                       in-kernel without any host round trip.
+
+Because TTL expiry is resolved on the device, the host tracks only an
+*estimate* of each entry's expiry (refreshed to now+duration on every access)
+which it uses for hit/miss accounting and to prefer reclaiming expired slots.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+
+class SlotTable:
+    """Fixed-capacity key→slot table with LRU eviction.
+
+    `lookup` returns (slot, is_init): is_init is True when the key was just
+    assigned a (possibly recycled) slot, telling the kernel to take the
+    cache-miss path regardless of what the slot's previous tenant left behind.
+    """
+
+    __slots__ = ("capacity", "_entries", "_free", "hits", "misses")
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        # key -> [slot, expire_estimate_ms]; insertion order == LRU order
+        # (oldest first), maintained with move_to_end on access.
+        self._entries: "OrderedDict[str, list]" = OrderedDict()
+        self._free = list(range(capacity - 1, -1, -1))
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._entries
+
+    def lookup(self, key: str, now: int, duration: int) -> Tuple[int, bool]:
+        """Find or allocate the slot for `key`. Returns (slot, is_init)."""
+        ent = self._entries.get(key)
+        if ent is not None:
+            # Reference counts an expired entry as a miss (lru.go:110-114);
+            # we approximate with the host-side expiry estimate.
+            if ent[1] < now:
+                self.misses += 1
+            else:
+                self.hits += 1
+            ent[1] = now + duration
+            self._entries.move_to_end(key)
+            return ent[0], False
+
+        self.misses += 1
+        if self._free:
+            slot = self._free.pop()
+        else:
+            # Evict the least-recently-used entry (lru.go:92-94,131-136).
+            _, old = self._entries.popitem(last=False)
+            slot = old[0]
+        self._entries[key] = [slot, now + duration]
+        return slot, True
+
+    def peek(self, key: str) -> Optional[int]:
+        """Slot for key without LRU touch or allocation; None if absent."""
+        ent = self._entries.get(key)
+        return None if ent is None else ent[0]
+
+    def remove(self, key: str) -> None:
+        ent = self._entries.pop(key, None)
+        if ent is not None:
+            self._free.append(ent[0])

@@ -1,0 +1,95 @@
+"""Pure-Python oracle of the reference algorithm semantics, for fuzz tests.
+
+A direct behavioral model of reference algorithms.go:24-186 + cache/lru.go
+lazy expiry (with the three documented divergences from
+gubernator_tpu/ops/kernel.py applied: algorithm-switch reinit, leaky expiry
+now+duration, leaky rate clamped >= 1).  Used only to cross-check the kernel
+on randomized workloads — never shipped.
+"""
+
+from __future__ import annotations
+
+from gubernator_tpu.api.types import Algorithm, RateLimitReq, RateLimitResp, Status
+
+
+class PyRefCache:
+    def __init__(self):
+        self.entries = {}  # key -> dict
+
+    def hit(self, r: RateLimitReq, now: int) -> RateLimitResp:
+        key = r.hash_key()
+        e = self.entries.get(key)
+        if e is not None and e["expire"] < now:
+            e = None
+        if e is not None and e["algo"] != r.algorithm:
+            e = None  # divergence: reinit under requested algorithm
+
+        if r.algorithm == Algorithm.TOKEN_BUCKET:
+            if e is None:
+                expire = now + r.duration
+                remaining = r.limit - r.hits
+                status = Status.UNDER_LIMIT
+                if r.hits > r.limit:
+                    status = Status.OVER_LIMIT
+                    remaining = 0
+                self.entries[key] = {
+                    "algo": Algorithm.TOKEN_BUCKET, "limit": r.limit,
+                    "duration": r.duration, "remaining": remaining,
+                    "reset": expire, "expire": expire,
+                }
+                return RateLimitResp(status=status, limit=r.limit,
+                                     remaining=remaining, reset_time=expire)
+            if e["remaining"] == 0:
+                return RateLimitResp(status=Status.OVER_LIMIT, limit=e["limit"],
+                                     remaining=0, reset_time=e["reset"])
+            if r.hits == 0:
+                return RateLimitResp(status=Status.UNDER_LIMIT, limit=e["limit"],
+                                     remaining=e["remaining"], reset_time=e["reset"])
+            if r.hits == e["remaining"]:
+                e["remaining"] = 0
+                return RateLimitResp(status=Status.UNDER_LIMIT, limit=e["limit"],
+                                     remaining=0, reset_time=e["reset"])
+            if r.hits > e["remaining"]:
+                return RateLimitResp(status=Status.OVER_LIMIT, limit=e["limit"],
+                                     remaining=e["remaining"], reset_time=e["reset"])
+            e["remaining"] -= r.hits
+            return RateLimitResp(status=Status.UNDER_LIMIT, limit=e["limit"],
+                                 remaining=e["remaining"], reset_time=e["reset"])
+
+        # LEAKY_BUCKET
+        if e is None:
+            remaining = r.limit - r.hits
+            status = Status.UNDER_LIMIT
+            if r.hits > r.limit:
+                status = Status.OVER_LIMIT
+                remaining = 0
+            self.entries[key] = {
+                "algo": Algorithm.LEAKY_BUCKET, "limit": r.limit,
+                "duration": r.duration, "remaining": remaining,
+                "ts": now, "expire": now + r.duration,
+            }
+            return RateLimitResp(status=status, limit=r.limit,
+                                 remaining=remaining, reset_time=0)
+        rate = e["duration"] // max(r.limit, 1)
+        rate = max(rate, 1)
+        leak = (now - e["ts"]) // rate
+        e["remaining"] = min(e["remaining"] + leak, e["limit"])
+        if r.hits != 0:
+            e["ts"] = now
+        if e["remaining"] == 0:
+            return RateLimitResp(status=Status.OVER_LIMIT, limit=e["limit"],
+                                 remaining=0, reset_time=now + rate)
+        if r.hits == e["remaining"]:
+            e["remaining"] = 0
+            return RateLimitResp(status=Status.UNDER_LIMIT, limit=e["limit"],
+                                 remaining=0, reset_time=0)
+        if r.hits > e["remaining"]:
+            return RateLimitResp(status=Status.OVER_LIMIT, limit=e["limit"],
+                                 remaining=e["remaining"], reset_time=now + rate)
+        if r.hits == 0:
+            return RateLimitResp(status=Status.UNDER_LIMIT, limit=e["limit"],
+                                 remaining=e["remaining"], reset_time=0)
+        e["remaining"] -= r.hits
+        e["expire"] = now + r.duration
+        return RateLimitResp(status=Status.UNDER_LIMIT, limit=e["limit"],
+                             remaining=e["remaining"], reset_time=0)

@@ -1,0 +1,167 @@
+"""Mesh-sharded engine tests on an 8-device virtual CPU mesh.
+
+Mirrors the reference's black-box cluster strategy (SURVEY.md §4): the 8
+virtual devices play the role of the 6-node loopback cluster, exercising
+routing + sharding implicitly on every request.
+"""
+
+import random
+
+import pytest
+
+import gubernator_tpu  # noqa: F401
+import jax
+
+from gubernator_tpu.api.types import (
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    Second,
+    Status,
+)
+from gubernator_tpu.core.engine import RateLimitEngine, shard_of
+from .pyref import PyRefCache
+
+T0 = 1_700_000_000_000
+
+
+@pytest.fixture(scope="module")
+def engine():
+    assert len(jax.devices()) == 8
+    return RateLimitEngine(
+        capacity_per_shard=512,
+        batch_per_shard=128,
+        global_capacity=128,
+        global_batch_per_shard=32,
+        max_global_updates=32,
+    )
+
+
+def req(name, key, hits=1, limit=2, duration=Second,
+        algo=Algorithm.TOKEN_BUCKET, behavior=Behavior.BATCHING):
+    return RateLimitReq(name=name, unique_key=key, hits=hits, limit=limit,
+                        duration=duration, algorithm=algo, behavior=behavior)
+
+
+def test_mesh_is_eight_shards(engine):
+    assert engine.num_shards == 8
+
+
+def test_over_the_limit_via_engine(engine):
+    expect = [(1, Status.UNDER_LIMIT), (0, Status.UNDER_LIMIT), (0, Status.OVER_LIMIT)]
+    for remaining, status in expect:
+        r = engine.step([req("eng_over_limit", "account:1234")], now=T0)[0]
+        assert (r.remaining, r.status) == (remaining, status)
+        assert r.limit == 2
+        assert r.reset_time != 0
+
+
+def test_keys_spread_across_shards(engine):
+    keys = [f"spread_test_k{i}" for i in range(64)]
+    shards = {shard_of("spread_" + k, engine.num_shards) for k in keys}
+    assert len(shards) >= 4  # crc32 spreads over most of 8 shards
+    reqs = [req("spread", k, limit=10) for k in keys]
+    rs = engine.step(reqs, now=T0)
+    assert all(r.remaining == 9 for r in rs)
+    # second window decrements each again
+    rs = engine.step(reqs, now=T0 + 1)
+    assert all(r.remaining == 8 for r in rs)
+
+
+def test_global_stale_then_consistent(engine):
+    """functional_test.go:271-311 through the psum path.
+
+    Within one window a GLOBAL hit answers from the (stale) replica; the psum
+    at window end reconciles every shard.  Reference observes 4, 4 then 3
+    after sync — here: both first-window hits answer as-if-init (4), the
+    window's psum applies both hits, and the next read sees 3.
+    """
+    g = lambda hits: req("eng_global", "account:1234", hits=hits, limit=5,
+                         duration=3 * Second, behavior=Behavior.GLOBAL)
+    r1, r2 = engine.step([g(1), g(1)], now=T0)
+    assert (r1.status, r1.remaining) == (Status.UNDER_LIMIT, 4)
+    assert (r2.status, r2.remaining) == (Status.UNDER_LIMIT, 4)
+    r3 = engine.step([g(0)], now=T0 + 10)[0]
+    assert (r3.status, r3.remaining) == (Status.UNDER_LIMIT, 3)
+    # hits keep reconciling window by window
+    r4 = engine.step([g(1)], now=T0 + 20)[0]
+    assert r4.remaining == 3  # stale within the window
+    r5 = engine.step([g(0)], now=T0 + 30)[0]
+    assert r5.remaining == 2
+
+
+def test_global_over_limit_enforced(engine):
+    g = lambda hits: req("eng_global_over", "k", hits=hits, limit=3,
+                         duration=3 * Second, behavior=Behavior.GLOBAL)
+    engine.step([g(3)], now=T0)
+    r = engine.step([g(1)], now=T0 + 1)[0]
+    assert r.status == Status.OVER_LIMIT
+    assert r.remaining == 0
+
+
+def test_global_replicas_identical(engine):
+    # after any mix of traffic, the replicated arena must be bit-identical
+    # on every device
+    g = lambda k, hits: req("eng_global_rep", k, hits=hits, limit=100,
+                            duration=Second, behavior=Behavior.GLOBAL)
+    engine.step([g(f"k{i}", 1) for i in range(10)], now=T0)
+    for arr in engine.gstate:
+        shards = [jax.device_get(s.data) for s in arr.addressable_shards]
+        for s in shards[1:]:
+            assert (s == shards[0]).all()
+
+
+def test_global_config_refresh_on_live_key(engine):
+    # Raising the limit on a live GLOBAL key must take effect at the next
+    # reconcile (the reference owner applies the config carried on each
+    # aggregated request) — not be frozen until TTL expiry.
+    g = lambda hits, limit: req("eng_global_cfg", "k", hits=hits, limit=limit,
+                                duration=60 * Second, behavior=Behavior.GLOBAL)
+    engine.step([g(2, 5)], now=T0)      # init: remaining 3
+    engine.step([g(1, 50)], now=T0 + 1)  # raise limit; apply 1 hit
+    r = engine.step([g(0, 50)], now=T0 + 2)[0]
+    # token hit path keeps the stored limit (algorithm semantics), but after
+    # expiry the refreshed config must win:
+    engine.step([g(0, 50)], now=T0 + 61 * Second)
+    r = engine.step([g(1, 50)], now=T0 + 61 * Second + 10)[0]
+    assert r.limit == 50
+    assert r.remaining == 49
+
+
+def test_process_chunks_oversized_windows(engine):
+    base = [req("eng_chunk", f"k{i}", limit=5, duration=Second)
+            for i in range(300)]
+    reqs = base * 4  # ~150 lanes/shard vs cap 128 -> must chunk
+    rs = engine.process(reqs, now=T0)
+    assert len(rs) == 1200
+    by_key = {}
+    for r_, resp in zip(reqs, rs):
+        by_key.setdefault(r_.unique_key, []).append(resp.remaining)
+    for k, vals in by_key.items():
+        assert vals == [4, 3, 2, 1], k
+
+
+def test_fuzz_against_python_oracle(engine):
+    """Randomized workload compared against the pure-Python reference model."""
+    rng = random.Random(42)
+    oracle = PyRefCache()
+    now = T0 + 500_000
+    keys = [f"fz{i}" for i in range(12)]
+    for w in range(30):
+        n = rng.randint(1, 20)
+        window = []
+        for _ in range(n):
+            window.append(req(
+                "eng_fuzz", rng.choice(keys),
+                hits=rng.choice([0, 1, 1, 2, 3, 10]),
+                limit=rng.choice([1, 3, 5]),
+                duration=rng.choice([1, 5, 40, 1000]),
+                algo=rng.choice([Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET]),
+            ))
+        got = engine.step(window, now=now)
+        want = [oracle.hit(r, now) for r in window]
+        for i, (g_, w_) in enumerate(zip(got, want)):
+            assert (g_.status, g_.remaining, g_.limit, g_.reset_time) == \
+                   (w_.status, w_.remaining, w_.limit, w_.reset_time), \
+                   f"window {w} item {i}: {window[i]}"
+        now += rng.choice([0, 1, 3, 10, 50])

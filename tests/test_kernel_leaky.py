@@ -1,0 +1,152 @@
+"""Leaky-bucket kernel semantics: every branch of reference algorithms.go:88-186."""
+
+from gubernator_tpu.api.types import Algorithm, RateLimitReq, Status
+from .harness import KernelHarness
+
+
+def req(hits=1, limit=5, duration=50, key="account:1234", name="test_leaky"):
+    return RateLimitReq(name=name, unique_key=key, hits=hits, limit=limit,
+                        duration=duration, algorithm=Algorithm.LEAKY_BUCKET)
+
+
+def test_leaky_bucket_table():
+    # functional_test.go:148-206: duration=50ms, limit=5 -> rate=10ms/token
+    h = KernelHarness()
+    r = h.one(req(hits=5))
+    assert (r.remaining, r.status) == (0, Status.UNDER_LIMIT)
+    r = h.one(req(hits=1))
+    assert (r.remaining, r.status) == (0, Status.OVER_LIMIT)
+    h.advance(10)
+    r = h.one(req(hits=1))  # leaked 1, exact drain
+    assert (r.remaining, r.status) == (0, Status.UNDER_LIMIT)
+    h.advance(20)
+    r = h.one(req(hits=1))  # leaked 2, consume 1
+    assert (r.remaining, r.status) == (1, Status.UNDER_LIMIT)
+    assert r.limit == 5
+
+
+def test_leaky_init_reset_time_zero():
+    # algorithms.go:169-174: init response carries ResetTime 0
+    h = KernelHarness()
+    r = h.one(req(hits=1))
+    assert r.reset_time == 0
+    assert r.remaining == 4
+
+
+def test_leaky_over_limit_reset_time():
+    # algorithms.go:130-134: OVER_LIMIT responses carry now + rate
+    h = KernelHarness()
+    h.one(req(hits=5))
+    r = h.one(req(hits=1))
+    assert r.status == Status.OVER_LIMIT
+    assert r.reset_time == h.now + 10  # rate = 50/5
+
+
+def test_leaky_over_ask_no_decrement_but_ts_advances():
+    # algorithms.go:118-121,143-148: rejection does not decrement, but the
+    # timestamp DOES advance (hits != 0), pushing the next leak out.
+    h = KernelHarness()
+    h.one(req(hits=4))  # remaining 1
+    h.advance(9)  # not enough to leak (rate 10)
+    r = h.one(req(hits=3))  # over-ask: remaining 1
+    assert (r.status, r.remaining) == (Status.OVER_LIMIT, 1)
+    h.advance(9)
+    # only 9ms since ts was refreshed by the rejected request -> still no leak
+    r = h.one(req(hits=0))
+    assert r.remaining == 1
+    h.advance(1)
+    r = h.one(req(hits=0))  # 10ms since refresh -> leak 1
+    assert r.remaining == 2
+
+
+def test_leaky_read_does_not_advance_ts():
+    # algorithms.go:118-121: hits=0 reads leak but don't move the timestamp,
+    # so the same leak is re-applied on the next read (clamped to limit).
+    h = KernelHarness()
+    h.one(req(hits=4))  # remaining 1, ts = t0
+    h.advance(10)
+    r = h.one(req(hits=0))
+    assert r.remaining == 2  # leak 1 applied and persisted
+    r = h.one(req(hits=0))
+    assert r.remaining == 3  # same leak applied again (ts never advanced)
+
+
+def test_leaky_clamp_to_limit():
+    h = KernelHarness()
+    h.one(req(hits=3))  # remaining 2
+    h.advance(1000)  # would leak 100
+    r = h.one(req(hits=0))
+    assert r.remaining == 5  # clamped (algorithms.go:113-115)
+
+
+def test_leaky_rate_uses_request_limit():
+    # algorithms.go:107: rate = stored duration / REQUEST limit
+    h = KernelHarness()
+    h.one(req(hits=4, limit=5, duration=50))  # stored duration 50, remaining 1
+    h.advance(5)
+    # request limit=10 -> rate = 50/10 = 5 -> leak 1 even though stored
+    # limit's rate (10ms) hasn't elapsed
+    r = h.one(req(hits=0, limit=10))
+    assert r.remaining == 2
+
+
+def test_leaky_init_over_ask():
+    # algorithms.go:176-181: first request over limit -> OVER, stored at 0
+    h = KernelHarness()
+    r = h.one(req(hits=9, limit=5))
+    assert (r.status, r.remaining) == (Status.OVER_LIMIT, 0)
+    r = h.one(req(hits=0))
+    assert r.status == Status.OVER_LIMIT  # remaining 0 -> OVER (algorithms.go:130)
+
+
+def test_leaky_refills_over_time_after_drain():
+    h = KernelHarness()
+    h.one(req(hits=5))
+    h.advance(50)
+    r = h.one(req(hits=0))
+    assert r.remaining == 5
+
+
+def test_leaky_duplicates_in_window():
+    # in-window: first nonzero hit pins ts to now; later hits same window
+    # leak 0 more
+    h = KernelHarness()
+    h.one(req(hits=5))  # drain
+    h.advance(30)  # leak 3 available
+    rs = h.window([req(hits=1), req(hits=1), req(hits=1), req(hits=1)])
+    assert [r.remaining for r in rs] == [2, 1, 0, 0]
+    assert rs[2].status == Status.UNDER_LIMIT  # exact drain
+    assert rs[3].status == Status.OVER_LIMIT
+
+
+def test_leaky_zero_hit_reads_in_window_reapply_leak():
+    # reads before the first consuming hit each re-apply the leak
+    # (consequence of algorithms.go:110-121 with a shared window timestamp)
+    h = KernelHarness()
+    h.one(req(hits=4))  # remaining 1
+    h.advance(10)  # leak 1 pending
+    rs = h.window([req(hits=0), req(hits=0), req(hits=1)])
+    assert [r.remaining for r in rs] == [2, 3, 3]
+
+
+def test_leaky_expiry_resets():
+    h = KernelHarness()
+    h.one(req(hits=3, duration=50))
+    h.advance(51)
+    r = h.one(req(hits=1, duration=50))
+    assert r.remaining == 4  # fresh bucket
+
+
+def test_leaky_expiry_extended_only_by_decrement():
+    # algorithms.go:155-157 (corrected): only a successful decrement extends
+    # the entry's life; reads/rejections don't.
+    h = KernelHarness()
+    h.one(req(hits=1, duration=50))  # expire at t0+50
+    h.advance(40)
+    h.one(req(hits=1, duration=50))  # decrement -> expire at t0+90
+    h.advance(45)  # t0+85 < t0+90: still alive
+    r = h.one(req(hits=0, duration=50))
+    assert r.remaining == 5  # leaked back to full, not re-initialized
+    h.advance(10)  # t0+95 > t0+90: expired
+    r = h.one(req(hits=1, duration=50))
+    assert r.remaining == 4
