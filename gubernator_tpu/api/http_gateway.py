@@ -1,0 +1,79 @@
+"""HTTP JSON gateway: REST access to the same service.
+
+Replaces the reference's grpc-gateway reverse proxy
+(gubernator.pb.gw.go:59-148, wired in cmd/gubernator/main.go:107-116) with a
+thin aiohttp app speaking the same proto3-JSON mapping (field names
+camelCased, enums as strings — via google.protobuf.json_format, the same
+conversion rules grpc-gateway uses):
+
+  POST /v1/GetRateLimits   body: GetRateLimitsReq JSON
+  GET  /v1/HealthCheck
+  GET  /metrics            prometheus text format (main.go:113-116)
+
+Unlike the gateway in the reference (which dials the node's own gRPC port
+over TCP), this calls the Instance in-process.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+from google.protobuf import json_format
+
+from gubernator_tpu.api import pb
+from gubernator_tpu.core.service import BatchTooLargeError, Instance
+from gubernator_tpu.observability.metrics import CONTENT_TYPE_LATEST
+
+
+def build_app(instance: Instance) -> web.Application:
+    async def get_rate_limits(request: web.Request) -> web.Response:
+        try:
+            body = await request.text()
+            msg = json_format.Parse(body, pb.GetRateLimitsReq())
+        except json_format.ParseError as e:
+            return web.json_response({"error": str(e), "code": 3}, status=400)
+        try:
+            resps = await instance.get_rate_limits(
+                [pb.req_from_pb(r) for r in msg.requests])
+        except BatchTooLargeError as e:
+            return web.json_response({"error": str(e), "code": 11}, status=400)
+        out = pb.GetRateLimitsResp(responses=[pb.resp_to_pb(r) for r in resps])
+        return web.json_response(
+            json_format.MessageToDict(out, preserving_proto_field_name=False))
+
+    async def health_check(request: web.Request) -> web.Response:
+        h = await instance.health_check()
+        msg = pb.HealthCheckResp(
+            status=h.status, message=h.message, peer_count=h.peer_count)
+        return web.json_response(
+            json_format.MessageToDict(msg, preserving_proto_field_name=False))
+
+    async def metrics(request: web.Request) -> web.Response:
+        return web.Response(
+            body=instance.metrics.expose(),
+            content_type=CONTENT_TYPE_LATEST.split(";")[0],
+        )
+
+    app = web.Application()
+    app.router.add_post("/v1/GetRateLimits", get_rate_limits)
+    app.router.add_get("/v1/HealthCheck", health_check)
+    app.router.add_get("/metrics", metrics)
+    return app
+
+
+class HttpGateway:
+    def __init__(self, instance: Instance, address: str):
+        self.app = build_app(instance)
+        host, _, port = address.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()

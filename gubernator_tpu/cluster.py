@@ -1,0 +1,113 @@
+"""In-process multi-node cluster harness for tests and local development.
+
+The reference's answer to "multi-node without a real cluster"
+(cluster/cluster.go:29-124): N full Instances, each with its own gRPC server
+on a real loopback socket, wired into a static full-mesh peer list with
+IsOwner set by address match — no discovery backend.  Global sync is tuned
+fast for tests (50ms, cluster.go:87).
+
+Every instance shares the process's device mesh but owns its own arenas, so
+the cluster really exercises the cross-host protocol (forwarding, hit
+aggregation, broadcasts) over real gRPC.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import replace
+from typing import List, Optional, Sequence
+
+from gubernator_tpu.config import BehaviorConfig, Config, EngineConfig, PeerInfo
+from gubernator_tpu.core.service import Instance
+from gubernator_tpu.server import GrpcServer
+
+
+class ClusterNode:
+    def __init__(self, instance: Instance, server: GrpcServer):
+        self.instance = instance
+        self.server = server
+        self.address = server.address
+
+
+class Cluster:
+    def __init__(self):
+        self.nodes: List[ClusterNode] = []
+
+    @property
+    def addresses(self) -> List[str]:
+        return [n.address for n in self.nodes]
+
+    def get_peer(self) -> str:
+        """A random node address (cluster.go:55-57) — tests dial randomly so
+        routing/forwarding is exercised implicitly."""
+        return random.choice(self.addresses)
+
+    def peer_at(self, idx: int) -> str:
+        return self.nodes[idx].address
+
+    def instance_at(self, idx: int) -> Instance:
+        return self.nodes[idx].instance
+
+    async def owner_index_of(self, key: str) -> int:
+        """Index of the node owning `key` — lets tests pick a deliberately
+        non-owner node (functional_test.go:283-285)."""
+        inst = self.nodes[0].instance
+        owner = inst.get_peer(key)
+        return self.addresses.index(owner.host)
+
+    async def stop(self) -> None:
+        for n in self.nodes:
+            await n.server.stop()
+            n.instance.close()
+        self.nodes = []
+
+
+async def start_with(
+    addresses: Sequence[str],
+    behaviors: Optional[BehaviorConfig] = None,
+    engine: Optional[EngineConfig] = None,
+) -> Cluster:
+    """Boot one Instance+server per address and wire the full mesh
+    (cluster.go:70-118)."""
+    if behaviors is None:
+        # fast global sync for tests (cluster.go:87)
+        behaviors = BehaviorConfig(global_sync_wait=0.05)
+    if engine is None:
+        engine = EngineConfig(
+            capacity_per_shard=512, batch_per_shard=128,
+            global_capacity=128, global_batch_per_shard=32,
+            max_global_updates=32,
+        )
+    cluster = Cluster()
+    try:
+        for addr in addresses:
+            conf = Config(behaviors=replace(behaviors), engine=engine,
+                          advertise_address=addr)
+            inst = Instance(conf)
+            server = GrpcServer(inst, addr)
+            await server.start()
+            inst.advertise_address = server.address
+            cluster.nodes.append(ClusterNode(inst, server))
+
+        # compile the shared device step before serving — otherwise the first
+        # real window pays a multi-second jit while peer batch RPCs time out
+        cluster.nodes[0].instance.engine.step([])
+
+        peers = [PeerInfo(address=a) for a in cluster.addresses]
+        for node in cluster.nodes:
+            # IsOwner marks self by address match (cluster.go:35-45)
+            infos = [PeerInfo(address=p.address,
+                              is_owner=(p.address == node.address))
+                     for p in peers]
+            await node.instance.set_peers(infos)
+    except Exception:
+        await cluster.stop()
+        raise
+    return cluster
+
+
+async def start(count: int = 6,
+                behaviors: Optional[BehaviorConfig] = None,
+                engine: Optional[EngineConfig] = None) -> Cluster:
+    """N nodes on ephemeral loopback ports (cluster.go:70-76)."""
+    return await start_with(["127.0.0.1:0"] * count, behaviors, engine)

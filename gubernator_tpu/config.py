@@ -1,0 +1,188 @@
+"""Configuration: library structs + GUBER_* environment config.
+
+Mirrors both reference config surfaces:
+  * library embedding contract (reference config.go:28-75): Config /
+    BehaviorConfig structs with the same defaults (500ms timeouts, 500µs
+    windows, batch limit 1000);
+  * daemon env-var config (reference cmd/gubernator/config.go:59-147): the
+    same GUBER_* variable names, optional KEY=value env-file, k8s/etcd
+    mutual exclusivity.
+
+New TPU-specific knobs live under GUBER_TPU_* (arena capacity, window lanes)
+— absent from the reference because its cache is a host hash map.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+# Hard cap on items per RPC (reference gubernator.go:34).
+MAX_BATCH_SIZE = 1000
+
+
+@dataclass
+class BehaviorConfig:
+    """Batching/global windows (reference config.go:43-57, defaults :59-66).
+
+    Durations are seconds (float) — the reference uses Go time.Duration;
+    0.0005 == the reference's 500µs default.
+    """
+
+    batch_timeout: float = 0.5
+    batch_wait: float = 0.0005
+    batch_limit: int = MAX_BATCH_SIZE
+    global_sync_wait: float = 0.0005
+    global_timeout: float = 0.5
+    global_batch_limit: int = MAX_BATCH_SIZE
+
+    def validate(self) -> None:
+        if self.batch_limit > MAX_BATCH_SIZE:
+            raise ValueError(f"Behaviors.BatchLimit cannot exceed '{MAX_BATCH_SIZE}'")
+
+
+@dataclass
+class EngineConfig:
+    """Dimensions of the device arenas (no reference analog: replaces the
+    LRU cache size knob GUBER_CACHE_SIZE / cache/lru.go:50)."""
+
+    capacity_per_shard: int = 65536
+    batch_per_shard: int = 1024
+    global_capacity: int = 4096
+    global_batch_per_shard: int = 256
+    max_global_updates: int = 256
+
+
+@dataclass
+class PeerInfo:
+    # reference etcd.go:29-32
+    address: str = ""
+    is_owner: bool = False
+
+
+@dataclass
+class Config:
+    """Library config (reference config.go:28-41).  The reference requires a
+    grpc.Server; here the Instance owns its grpc.aio server bound to
+    `grpc_address` (or none, for embedded/standalone use)."""
+
+    grpc_address: str = ""
+    behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
+    # advertise address used for self-identification in the peer ring
+    advertise_address: str = ""
+
+
+@dataclass
+class DaemonConfig:
+    """Daemon env config (reference cmd/gubernator/config.go:42-57)."""
+
+    grpc_listen_address: str = "localhost:81"
+    http_listen_address: str = "localhost:80"
+    advertise_address: str = ""
+    cache_size: int = 50000  # reference default, example.conf:11
+    debug: bool = False
+
+    # k8s discovery
+    k8s_namespace: str = ""
+    k8s_pod_ip: str = ""
+    k8s_pod_port: str = ""
+    k8s_endpoints_selector: str = ""
+
+    # etcd discovery
+    etcd_addresses: List[str] = field(default_factory=list)
+    etcd_prefix: str = "/gubernator/peers/"
+    etcd_dial_timeout: float = 5.0
+    etcd_username: str = ""
+    etcd_password: str = ""
+
+    behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
+
+    @property
+    def k8s_enabled(self) -> bool:
+        return bool(self.k8s_namespace)
+
+    @property
+    def etcd_enabled(self) -> bool:
+        return bool(self.etcd_addresses)
+
+
+def _env(name: str, default: str = "") -> str:
+    v = os.environ.get(name)
+    return v if v not in (None, "") else default
+
+
+def load_env_file(path: str) -> None:
+    """Load a KEY=value file into the process env (reference
+    cmd/gubernator/config.go:239-267): '#' comments, blank lines skipped,
+    malformed lines rejected."""
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" not in line:
+                raise ValueError(f"malformed key=value on line '{ln}'")
+            k, _, v = line.partition("=")
+            os.environ[k.strip()] = v.strip()
+
+
+def config_from_env(env_file: Optional[str] = None) -> DaemonConfig:
+    """Assemble DaemonConfig from GUBER_* env vars (reference
+    cmd/gubernator/config.go:59-147; full variable list example.conf:1-96)."""
+    if env_file:
+        load_env_file(env_file)
+
+    c = DaemonConfig()
+    c.grpc_listen_address = _env("GUBER_GRPC_ADDRESS", c.grpc_listen_address)
+    c.http_listen_address = _env("GUBER_HTTP_ADDRESS", c.http_listen_address)
+    c.advertise_address = _env("GUBER_ADVERTISE_ADDRESS", c.grpc_listen_address)
+    c.cache_size = int(_env("GUBER_CACHE_SIZE", str(c.cache_size)))
+    c.debug = _env("GUBER_DEBUG") in ("true", "1", "yes")
+
+    c.k8s_namespace = _env("GUBER_K8S_NAMESPACE")
+    c.k8s_pod_ip = _env("GUBER_K8S_POD_IP")
+    c.k8s_pod_port = _env("GUBER_K8S_POD_PORT")
+    c.k8s_endpoints_selector = _env("GUBER_K8S_ENDPOINTS_SELECTOR")
+
+    etcd = _env("GUBER_ETCD_ENDPOINTS")
+    c.etcd_addresses = [a.strip() for a in etcd.split(",") if a.strip()]
+    c.etcd_prefix = _env("GUBER_ETCD_KEY_PREFIX", c.etcd_prefix)
+    c.etcd_dial_timeout = float(_env("GUBER_ETCD_DIAL_TIMEOUT", "5"))
+    c.etcd_username = _env("GUBER_ETCD_USER")
+    c.etcd_password = _env("GUBER_ETCD_PASSWORD")
+
+    # reference config.go:118-133: the two discovery backends are exclusive
+    if c.k8s_enabled and c.etcd_enabled:
+        raise ValueError("set only one of GUBER_K8S_NAMESPACE or GUBER_ETCD_ENDPOINTS")
+
+    b = c.behaviors
+    if _env("GUBER_BATCH_TIMEOUT"):
+        b.batch_timeout = float(_env("GUBER_BATCH_TIMEOUT"))
+    if _env("GUBER_BATCH_WAIT"):
+        b.batch_wait = float(_env("GUBER_BATCH_WAIT"))
+    if _env("GUBER_BATCH_LIMIT"):
+        b.batch_limit = int(_env("GUBER_BATCH_LIMIT"))
+    if _env("GUBER_GLOBAL_SYNC_WAIT"):
+        b.global_sync_wait = float(_env("GUBER_GLOBAL_SYNC_WAIT"))
+    if _env("GUBER_GLOBAL_TIMEOUT"):
+        b.global_timeout = float(_env("GUBER_GLOBAL_TIMEOUT"))
+    if _env("GUBER_GLOBAL_BATCH_LIMIT"):
+        b.global_batch_limit = int(_env("GUBER_GLOBAL_BATCH_LIMIT"))
+    b.validate()
+
+    e = c.engine
+    if _env("GUBER_TPU_CAPACITY_PER_SHARD"):
+        e.capacity_per_shard = int(_env("GUBER_TPU_CAPACITY_PER_SHARD"))
+    elif c.cache_size:
+        # honor the reference knob: spread the requested cache size across
+        # the mesh
+        e.capacity_per_shard = max(1024, c.cache_size)
+    if _env("GUBER_TPU_BATCH_PER_SHARD"):
+        e.batch_per_shard = int(_env("GUBER_TPU_BATCH_PER_SHARD"))
+    if _env("GUBER_TPU_GLOBAL_CAPACITY"):
+        e.global_capacity = int(_env("GUBER_TPU_GLOBAL_CAPACITY"))
+
+    return c

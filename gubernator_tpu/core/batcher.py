@@ -1,0 +1,124 @@
+"""Window batcher: accumulates decisions into device windows.
+
+The TPU-side analog of the reference's per-peer batching loop
+(peers.go:143-172): requests queue until `batch_limit` (1000) items or
+`batch_wait` (500µs) elapses, then the whole window ships — there as one
+GetPeerRateLimits RPC, here as one device step.  Responses resolve back to
+awaiting callers by lane index (the reference demuxes by slice index,
+peers.go:204-207).
+
+The engine is not thread-safe, so all device work funnels through a
+single-thread executor; NO_BATCHING requests jump the window but share that
+serialization (the reference gets the same property from the cache mutex,
+gubernator.go:237).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence
+
+from gubernator_tpu.api.types import RateLimitReq, RateLimitResp
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.core.engine import RateLimitEngine
+from gubernator_tpu.core.interval import ArmedInterval
+
+
+class WindowBatcher:
+    def __init__(
+        self,
+        engine: RateLimitEngine,
+        behaviors: Optional[BehaviorConfig] = None,
+        metrics=None,
+    ):
+        self.engine = engine
+        self.behaviors = behaviors or BehaviorConfig()
+        self.metrics = metrics
+        self._pending: List[tuple] = []  # (req, accumulate, future)
+        self._interval: Optional[ArmedInterval] = None
+        self._waiter: Optional[asyncio.Task] = None
+        # one thread == one device stream; serializes all engine access
+        self._executor = ThreadPoolExecutor(max_workers=1,
+                                            thread_name_prefix="guber-device")
+        self._closed = False
+
+    # ------------------------------------------------------------- batched
+
+    async def submit(self, req: RateLimitReq, accumulate: bool = True) -> RateLimitResp:
+        """Queue into the current window; resolves when the window executes."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending.append((req, accumulate, fut))
+        if len(self._pending) >= self.behaviors.batch_limit:
+            self._flush()
+        elif len(self._pending) == 1:
+            if self._interval is None:
+                self._interval = ArmedInterval(self.behaviors.batch_wait)
+            self._interval.arm()
+            if self._waiter is None or self._waiter.done():
+                self._waiter = asyncio.create_task(self._wait_interval())
+        return await fut
+
+    async def _wait_interval(self) -> None:
+        await self._interval.wait()
+        if self._pending:
+            self._flush()
+
+    def _flush(self) -> None:
+        window = self._pending
+        self._pending = []
+        asyncio.create_task(self._run_window(window))
+
+    async def _run_window(self, window: List[tuple]) -> None:
+        reqs = [w[0] for w in window]
+        accumulate = [w[1] for w in window]
+        loop = asyncio.get_running_loop()
+        start = time.monotonic()
+        try:
+            resps = await loop.run_in_executor(
+                self._executor, lambda: self.engine.process(reqs, None, accumulate)
+            )
+        except Exception as e:  # resolve every waiter with the failure
+            for _, _, fut in window:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        if self.metrics is not None:
+            self.metrics.window_count.inc()
+            self.metrics.window_occupancy.observe(len(reqs))
+            self.metrics.window_duration.observe(time.monotonic() - start)
+        for (_, _, fut), resp in zip(window, resps):
+            if not fut.done():
+                fut.set_result(resp)
+
+    # ----------------------------------------------------------- immediate
+
+    async def submit_now(
+        self,
+        reqs: Sequence[RateLimitReq],
+        accumulate: Optional[Sequence[bool]] = None,
+    ) -> List[RateLimitResp]:
+        """Run a ready-made window immediately (NO_BATCHING fast path, and
+        batches arriving from peers that were already aggregated remotely)."""
+        loop = asyncio.get_running_loop()
+        acc = list(accumulate) if accumulate is not None else None
+        return await loop.run_in_executor(
+            self._executor, lambda: self.engine.process(reqs, None, acc)
+        )
+
+    async def apply_upserts(self, upserts: Sequence) -> None:
+        """Write owner-broadcast replica state (chunked to the engine cap)."""
+        loop = asyncio.get_running_loop()
+        cap = self.engine.max_global_updates
+        for i in range(0, len(upserts), cap):
+            chunk = list(upserts[i:i + cap])
+            await loop.run_in_executor(
+                self._executor, lambda c=chunk: self.engine.step([], upserts=c)
+            )
+
+    def close(self) -> None:
+        self._closed = True
+        if self._interval is not None:
+            self._interval.stop()
+        self._executor.shutdown(wait=False)

@@ -24,7 +24,7 @@ bit-exact.  Host-side key→slot tables (state/arena.py) are per shard.
 from __future__ import annotations
 
 import zlib
-from functools import partial
+from functools import lru_cache
 from typing import List, Optional, Sequence
 
 import jax
@@ -74,6 +74,9 @@ class _PackedWindow:
         self.is_init = np.zeros((S, B), dtype=bool)
         self.gslot = np.full((S, Bg), kernel.PAD_SLOT, dtype=np.int32)
         self.ghits = np.zeros((S, Bg), dtype=np.int64)
+        # hits contributed to the psum (0 for lanes whose hits reconcile via
+        # the cross-host path instead — see RateLimitEngine.step(accumulate))
+        self.ghits_acc = np.zeros((S, Bg), dtype=np.int64)
         self.glimit = np.zeros((S, Bg), dtype=np.int64)
         self.gduration = np.zeros((S, Bg), dtype=np.int64)
         self.galgo = np.zeros((S, Bg), dtype=np.int32)
@@ -83,14 +86,24 @@ class _PackedWindow:
         self.uduration = np.zeros((Kg,), dtype=np.int64)
         self.ualgo = np.zeros((Kg,), dtype=np.int32)
         self.rslot = np.zeros((Kg,), dtype=np.int32)
+        # owner-broadcast upsert lanes (cross-host GLOBAL replicas)
+        self.pslot = np.zeros((Kg,), dtype=np.int32)
+        self.plimit = np.zeros((Kg,), dtype=np.int64)
+        self.pduration = np.zeros((Kg,), dtype=np.int64)
+        self.premaining = np.zeros((Kg,), dtype=np.int64)
+        self.ptstamp = np.zeros((Kg,), dtype=np.int64)
+        self.pexpire = np.zeros((Kg,), dtype=np.int64)
+        self.palgo = np.zeros((Kg,), dtype=np.int32)
 
     def reset(self, G: int):
         self.slot.fill(kernel.PAD_SLOT)
         self.gslot.fill(kernel.PAD_SLOT)
         self.ghits.fill(0)
+        self.ghits_acc.fill(0)
         # pad config-update/reset lanes point one past the global arena → dropped
         self.uslot.fill(G)
         self.rslot.fill(G)
+        self.pslot.fill(G)
 
 
 class RateLimitEngine:
@@ -159,86 +172,58 @@ class RateLimitEngine:
     # ------------------------------------------------------------------ device
 
     def _build_step(self):
-        mesh = self.mesh
+        # All engines with the same mesh geometry share one compiled
+        # executable — a 4-node in-process cluster compiles once, not four
+        # times (each Instance owns an engine but the computation is pure).
+        return _compiled_step(self.mesh)
 
-        def shard_fn(state, gstate, gcfg, batch, gbatch, upd, now):
-            # Block shapes inside shard_map: state [1, C]; batch [1, B];
-            # gstate/gcfg [G] (replicated); upd [Kg] (replicated).
-            st = BucketState(*jax.tree.map(lambda a: a[0], state))
-            bt = WindowBatch(*jax.tree.map(lambda a: a[0], batch))
-            new_st, out = kernel.window_step(st, bt, now)
 
-            # Apply host-issued GLOBAL slot (re)configurations.  The config
-            # write refreshes limit/duration/algorithm from the latest request
-            # each window (the reference owner applies the config carried on
-            # each aggregated request, global.go:115-153); the state reset
-            # (expire=0 reads as never-initialized) happens only for lanes the
-            # host just (re)allocated.
-            uslot, ulimit, uduration, ualgo, rslot = upd
-            gcfg = GlobalConfig(
-                limit=gcfg.limit.at[uslot].set(ulimit, mode="drop"),
-                duration=gcfg.duration.at[uslot].set(uduration, mode="drop"),
-                algo=gcfg.algo.at[uslot].set(ualgo, mode="drop"),
-            )
-            gstate = gstate._replace(
-                expire=gstate.expire.at[rslot].set(jnp.int64(0), mode="drop")
-            )
-
-            gb = WindowBatch(*jax.tree.map(lambda a: a[0], gbatch))
-            gout = kernel.global_read(gstate, gb, now)
-            delta = kernel.global_accumulate(jnp.zeros_like(gstate.remaining), gb)
-            # The whole GLOBAL reconciliation — the reference's async hit send
-            # plus owner broadcast (global.go:72-232) — is this one collective.
-            summed = lax.psum(delta, SHARD_AXIS)
-            new_g = kernel.global_apply(gstate, gcfg, summed, now)
-
-            expand = lambda a: a[None]
-            return (
-                BucketState(*jax.tree.map(expand, new_st)),
-                WindowOutput(*jax.tree.map(expand, out)),
-                new_g,
-                gcfg,
-                WindowOutput(*jax.tree.map(expand, gout)),
-            )
-
-        sharded = jax.shard_map(
-            shard_fn,
-            mesh=mesh,
-            in_specs=(
-                jax.tree.map(lambda _: P(SHARD_AXIS), self.state),
-                jax.tree.map(lambda _: P(), self.gstate),
-                jax.tree.map(lambda _: P(), self.gcfg),
-                WindowBatch(*[P(SHARD_AXIS)] * 6),
-                WindowBatch(*[P(SHARD_AXIS)] * 6),
-                (P(), P(), P(), P(), P()),
-                P(),
-            ),
-            out_specs=(
-                jax.tree.map(lambda _: P(SHARD_AXIS), self.state),
-                WindowOutput(*[P(SHARD_AXIS)] * 4),
-                jax.tree.map(lambda _: P(), self.gstate),
-                jax.tree.map(lambda _: P(), self.gcfg),
-                WindowOutput(*[P(SHARD_AXIS)] * 4),
-            ),
-        )
-        return jax.jit(sharded, donate_argnums=(0, 1, 2))
-
-    # ------------------------------------------------------------------- host
 
     def step(
-        self, requests: Sequence[RateLimitReq], now: Optional[int] = None
+        self,
+        requests: Sequence[RateLimitReq],
+        now: Optional[int] = None,
+        accumulate: Optional[Sequence[bool]] = None,
+        upserts: Optional[Sequence] = None,
     ) -> List[RateLimitResp]:
         """Process one window of requests synchronously.
 
+        accumulate[i]=False keeps request i's GLOBAL hits out of the psum:
+        used by a non-owner *host* in a multi-host cluster, which answers
+        from its replica and reconciles hits with the owner over gRPC
+        (reference gubernator.go:173-195) rather than over the mesh.
+        upserts: UpdatePeerGlobal-shaped records (key, status, algorithm,
+        duration) from an owner broadcast, written into the replica arena
+        before this window's reads.
+
         Caller must respect the window caps (use `process` for auto-chunking):
         per-shard regular lanes <= batch_per_shard, per-shard GLOBAL lanes <=
-        global_batch_per_shard, distinct GLOBAL keys <= max_global_updates.
+        global_batch_per_shard, distinct GLOBAL keys + upserts <=
+        max_global_updates.
         """
         if now is None:
             now = millisecond_now()
         S = self.num_shards
         buf = self._buf
         buf.reset(self.global_capacity)
+
+        if upserts:
+            for i, u in enumerate(upserts):
+                slot, _ = self.gtable.lookup(u.key, now, u.duration)
+                st = u.status
+                buf.pslot[i] = slot
+                buf.plimit[i] = st.limit
+                buf.pduration[i] = u.duration
+                buf.premaining[i] = st.remaining
+                is_token = u.algorithm == Algorithm.TOKEN_BUCKET
+                # token: tstamp/expire are the bucket's reset_time; leaky: the
+                # timestamp restarts here and the entry lives a full duration
+                # (the reference's Add(key, status, status.ResetTime) leaves
+                # leaky replicas instantly expired — divergence documented in
+                # api/proto/peers.proto)
+                buf.ptstamp[i] = st.reset_time if is_token else now
+                buf.pexpire[i] = st.reset_time if is_token else now + u.duration
+                buf.palgo[i] = u.algorithm
 
         reg_fill = [0] * S
         glob_fill = [0] * S
@@ -250,18 +235,21 @@ class RateLimitEngine:
         # (shard, lane, is_global) per request, for demux
         lanes: List[tuple] = []
 
-        for r in requests:
+        for i, r in enumerate(requests):
             key = r.hash_key()
             s = shard_of(key, S)
             if r.behavior == Behavior.GLOBAL:
                 slot, is_init = self.gtable.lookup(key, now, r.duration)
-                gcfg_upd[slot] = (r.limit, r.duration, r.algorithm)
-                if is_init:
-                    greset.append(slot)
+                contribute = accumulate is None or accumulate[i]
+                if contribute:
+                    gcfg_upd[slot] = (r.limit, r.duration, r.algorithm)
+                    if is_init:
+                        greset.append(slot)
                 lane = glob_fill[s]
                 glob_fill[s] += 1
                 buf.gslot[s, lane] = slot
                 buf.ghits[s, lane] = r.hits
+                buf.ghits_acc[s, lane] = r.hits if contribute else 0
                 buf.glimit[s, lane] = r.limit
                 buf.gduration[s, lane] = r.duration
                 buf.galgo[s, lane] = r.algorithm
@@ -294,10 +282,12 @@ class RateLimitEngine:
             duration=buf.gduration, algo=buf.galgo, is_init=buf.gis_init,
         )
         upd = (buf.uslot, buf.ulimit, buf.uduration, buf.ualgo, buf.rslot)
+        ups = (buf.pslot, buf.plimit, buf.pduration, buf.premaining,
+               buf.ptstamp, buf.pexpire, buf.palgo)
 
         self.state, out, self.gstate, self.gcfg, gout = self._step_fn(
-            self.state, self.gstate, self.gcfg, batch, gbatch, upd,
-            jnp.int64(now),
+            self.state, self.gstate, self.gcfg, batch, gbatch, buf.ghits_acc,
+            upd, ups, jnp.int64(now),
         )
         out = jax.device_get(out)
         gout = jax.device_get(gout)
@@ -319,16 +309,29 @@ class RateLimitEngine:
         return responses
 
     def process(
-        self, requests: Sequence[RateLimitReq], now: Optional[int] = None
+        self,
+        requests: Sequence[RateLimitReq],
+        now: Optional[int] = None,
+        accumulate: Optional[Sequence[bool]] = None,
     ) -> List[RateLimitResp]:
         """step() with automatic chunking when a window overflows the caps."""
         S = self.num_shards
         out: List[RateLimitResp] = []
         chunk: List[RateLimitReq] = []
+        chunk_acc: List[bool] = []
         reg_fill = [0] * S
         glob_fill = [0] * S
         gkeys: set = set()
-        for r in requests:
+
+        def flush():
+            nonlocal chunk, chunk_acc, reg_fill, glob_fill, gkeys
+            out.extend(self.step(chunk, now, chunk_acc))
+            chunk, chunk_acc = [], []
+            reg_fill = [0] * S
+            glob_fill = [0] * S
+            gkeys = set()
+
+        for i, r in enumerate(requests):
             key = r.hash_key()
             s = shard_of(key, S)
             g = r.behavior == Behavior.GLOBAL
@@ -339,19 +342,16 @@ class RateLimitEngine:
                 or (len(gkeys) + new_gkey > self.max_global_updates)
             )
             if over:
-                out.extend(self.step(chunk, now))
-                chunk = []
-                reg_fill = [0] * S
-                glob_fill = [0] * S
-                gkeys = set()
+                flush()
             chunk.append(r)
+            chunk_acc.append(accumulate[i] if accumulate is not None else True)
             if g:
                 glob_fill[s] += 1
                 gkeys.add(key)
             else:
                 reg_fill[s] += 1
         if chunk:
-            out.extend(self.step(chunk, now))
+            flush()
         return out
 
     # ---------------------------------------------------------------- metrics
@@ -367,3 +367,92 @@ class RateLimitEngine:
     @property
     def cache_misses(self) -> int:
         return sum(t.misses for t in self.tables) + self.gtable.misses
+
+
+@lru_cache(maxsize=None)
+def _compiled_step(mesh: Mesh):
+    def shard_fn(state, gstate, gcfg, batch, gbatch, gacc, upd, ups, now):
+            # Block shapes inside shard_map: state [1, C]; batch/gbatch [1, B*];
+            # gstate/gcfg [G] (replicated); upd/ups [K*] (replicated).
+            st = BucketState(*jax.tree.map(lambda a: a[0], state))
+            bt = WindowBatch(*jax.tree.map(lambda a: a[0], batch))
+            new_st, out = kernel.window_step(st, bt, now)
+
+            # Owner-broadcast upserts land first: authoritative replica state
+            # pushed by a cross-host owner (the reference's UpdatePeerGlobals
+            # -> Cache.Add path, gubernator.go:199-207).
+            (pslot, plimit, pduration, premaining, ptstamp, pexpire, palgo) = ups
+            gstate = BucketState(
+                limit=gstate.limit.at[pslot].set(plimit, mode="drop"),
+                duration=gstate.duration.at[pslot].set(pduration, mode="drop"),
+                remaining=gstate.remaining.at[pslot].set(premaining, mode="drop"),
+                tstamp=gstate.tstamp.at[pslot].set(ptstamp, mode="drop"),
+                expire=gstate.expire.at[pslot].set(pexpire, mode="drop"),
+                algo=gstate.algo.at[pslot].set(palgo, mode="drop"),
+            )
+            gcfg = GlobalConfig(
+                limit=gcfg.limit.at[pslot].set(plimit, mode="drop"),
+                duration=gcfg.duration.at[pslot].set(pduration, mode="drop"),
+                algo=gcfg.algo.at[pslot].set(palgo, mode="drop"),
+            )
+
+            # Apply host-issued GLOBAL slot (re)configurations.  The config
+            # write refreshes limit/duration/algorithm from the latest request
+            # each window (the reference owner applies the config carried on
+            # each aggregated request, global.go:115-153); the state reset
+            # (expire=0 reads as never-initialized) happens only for lanes the
+            # host just (re)allocated.
+            uslot, ulimit, uduration, ualgo, rslot = upd
+            gcfg = GlobalConfig(
+                limit=gcfg.limit.at[uslot].set(ulimit, mode="drop"),
+                duration=gcfg.duration.at[uslot].set(uduration, mode="drop"),
+                algo=gcfg.algo.at[uslot].set(ualgo, mode="drop"),
+            )
+            gstate = gstate._replace(
+                expire=gstate.expire.at[rslot].set(jnp.int64(0), mode="drop")
+            )
+
+            gb = WindowBatch(*jax.tree.map(lambda a: a[0], gbatch))
+            gout = kernel.global_read(gstate, gb, now)
+            delta = kernel.global_accumulate(
+                jnp.zeros_like(gstate.remaining), gb._replace(hits=gacc[0])
+            )
+            # The whole GLOBAL reconciliation — the reference's async hit send
+            # plus owner broadcast (global.go:72-232) — is this one collective.
+            summed = lax.psum(delta, SHARD_AXIS)
+            new_g = kernel.global_apply(gstate, gcfg, summed, now)
+
+            expand = lambda a: a[None]
+            return (
+                BucketState(*jax.tree.map(expand, new_st)),
+                WindowOutput(*jax.tree.map(expand, out)),
+                new_g,
+                gcfg,
+                WindowOutput(*jax.tree.map(expand, gout)),
+            )
+
+    state_sharded = BucketState(*[P(SHARD_AXIS)] * 6)
+    state_repl = BucketState(*[P()] * 6)
+    sharded = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            state_sharded,
+            state_repl,
+            GlobalConfig(*[P()] * 3),
+            WindowBatch(*[P(SHARD_AXIS)] * 6),
+            WindowBatch(*[P(SHARD_AXIS)] * 6),
+            P(SHARD_AXIS),
+            (P(), P(), P(), P(), P()),
+            (P(),) * 7,
+            P(),
+        ),
+        out_specs=(
+            state_sharded,
+            WindowOutput(*[P(SHARD_AXIS)] * 4),
+            state_repl,
+            GlobalConfig(*[P()] * 3),
+            WindowOutput(*[P(SHARD_AXIS)] * 4),
+        ),
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1, 2))

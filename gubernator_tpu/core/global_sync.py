@@ -1,0 +1,164 @@
+"""Cross-host GLOBAL manager: async hit aggregation + owner broadcasts.
+
+Replaces the reference's globalManager (global.go:29-232) for the *between
+hosts* plane.  Within one mesh, GLOBAL limits reconcile with a single psum
+per window (core/engine.py); across hosts we keep the reference's
+eventually-consistent protocol:
+
+  (a) a non-owner host answers from its replica and queues the hits here;
+      `_run_hits` sums them per key (global.go:81-86) and every
+      global_sync_wait sends one aggregated request per key to the owning
+      host (global.go:115-153);
+  (b) an owner host queues every GLOBAL update here; `_run_broadcasts`
+      re-reads the authoritative status with hits=0 (global.go:199-203) and
+      pushes UpdatePeerGlobals to every other peer (global.go:215-229).
+
+Durations are observed into the same histograms the reference exports
+(async_durations / broadcast_durations, global.go:44-51).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import replace
+from typing import Dict, Optional
+
+from gubernator_tpu.api.types import RateLimitReq, UpdatePeerGlobal
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.core.interval import ArmedInterval
+
+
+class GlobalManager:
+    def __init__(self, behaviors: BehaviorConfig, instance, metrics=None, log=None):
+        self.conf = behaviors
+        self.instance = instance  # core.service.Instance
+        self.metrics = metrics
+        self.log = log
+        self._hits: Dict[str, RateLimitReq] = {}
+        self._updates: Dict[str, RateLimitReq] = {}
+        self._hit_interval: Optional[ArmedInterval] = None
+        self._bcast_interval: Optional[ArmedInterval] = None
+        self._tasks = []
+        self._started = False
+
+    def start(self) -> None:
+        if not self._started:
+            self._hit_interval = ArmedInterval(self.conf.global_sync_wait)
+            self._bcast_interval = ArmedInterval(self.conf.global_sync_wait)
+            self._started = True
+
+    def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        if self._hit_interval:
+            self._hit_interval.stop()
+        if self._bcast_interval:
+            self._bcast_interval.stop()
+
+    # ------------------------------------------------------------- queueing
+
+    def queue_hit(self, req: RateLimitReq) -> None:
+        """Aggregate a non-owner hit for async send (global.go:62-64,81-86)."""
+        key = req.hash_key()
+        cur = self._hits.get(key)
+        if cur is not None:
+            cur.hits += req.hits
+        else:
+            self._hits[key] = replace(req)
+        if len(self._hits) >= self.conf.global_batch_limit:
+            self._spawn(self._send_hits())
+        elif len(self._hits) == 1:
+            self._hit_interval.arm()
+            self._spawn_once("_hits_waiter_task", self._hits_waiter())
+
+    def queue_update(self, req: RateLimitReq) -> None:
+        """Mark a global key dirty for owner broadcast (global.go:66-68)."""
+        self._updates[req.hash_key()] = replace(req)
+        if len(self._updates) >= self.conf.global_batch_limit:
+            self._spawn(self._broadcast())
+        elif len(self._updates) == 1:
+            self._bcast_interval.arm()
+            self._spawn_once("_bcast_waiter_task", self._bcast_waiter())
+
+    def _spawn(self, coro) -> None:
+        t = asyncio.create_task(coro)
+        self._tasks.append(t)
+        t.add_done_callback(self._tasks.remove)
+
+    def _spawn_once(self, name: str, coro) -> None:
+        existing = getattr(self, name, None)
+        if existing is not None and not existing.done():
+            coro.close()
+            return
+        t = asyncio.create_task(coro)
+        setattr(self, name, t)
+
+    async def _hits_waiter(self) -> None:
+        await self._hit_interval.wait()
+        if self._hits:
+            await self._send_hits()
+
+    async def _bcast_waiter(self) -> None:
+        await self._bcast_interval.wait()
+        if self._updates:
+            await self._broadcast()
+
+    # ------------------------------------------------------------- sending
+
+    async def _send_hits(self) -> None:
+        hits, self._hits = self._hits, {}
+        start = time.monotonic()
+        # group aggregated requests by owning peer (global.go:124-140)
+        by_peer: Dict[str, list] = {}
+        clients = {}
+        for key, req in hits.items():
+            try:
+                peer = self.instance.get_peer(key)
+            except Exception as e:
+                if self.log:
+                    self.log.error("while getting peer for hash key '%s': %s", key, e)
+                continue
+            by_peer.setdefault(peer.host, []).append(req)
+            clients[peer.host] = peer
+        for host, reqs in by_peer.items():
+            try:
+                await clients[host].get_peer_rate_limits(reqs)
+            except Exception as e:
+                if self.log:
+                    self.log.error("error sending global hits to '%s': %s", host, e)
+                continue
+        if self.metrics is not None:
+            self.metrics.async_durations.observe(time.monotonic() - start)
+
+    async def _broadcast(self) -> None:
+        updates, self._updates = self._updates, {}
+        start = time.monotonic()
+        globals_ = []
+        for key, req in updates.items():
+            # authoritative status: re-read with behavior/hits cleared
+            # (global.go:199-203)
+            probe = replace(req, hits=0)
+            try:
+                status = await self.instance.read_global_status(probe)
+            except Exception as e:
+                if self.log:
+                    self.log.error(
+                        "while sending global updates to peers for '%s': %s", key, e)
+                continue
+            globals_.append(UpdatePeerGlobal(
+                key=key, status=status,
+                algorithm=req.algorithm, duration=req.duration,
+            ))
+        for peer in self.instance.peer_list():
+            if peer.is_owner:  # exclude ourselves (global.go:216-218)
+                continue
+            try:
+                await peer.update_peer_globals(globals_)
+            except Exception as e:
+                if self.log:
+                    self.log.error("error sending global updates to '%s': %s",
+                                   peer.host, e)
+                continue
+        if self.metrics is not None:
+            self.metrics.broadcast_durations.observe(time.monotonic() - start)

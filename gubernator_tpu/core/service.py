@@ -1,0 +1,229 @@
+"""Service core: request fan-out, ownership routing, behavior dispatch.
+
+The equivalent of the reference's Instance (gubernator.go:41-322), built
+around the device window engine instead of a mutex'd cache:
+
+  * public GetRateLimits: per-item validation (exact reference error
+    strings, gubernator.go:102-110), owner-vs-forward routing over the
+    consistent-hash ring (:114-152), the 1000-item RPC cap (:78-81);
+  * local decisions flow through the WindowBatcher → one device step per
+    window (replacing the per-key mutex'd algorithm calls, :236-251);
+  * peer plane GetPeerRateLimits/UpdatePeerGlobals (:199-227);
+  * GLOBAL behavior: owner applies + broadcasts; non-owner answers from its
+    replica and queues hits (:173-195) — within the mesh the psum does this
+    with zero RPCs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional, Sequence
+
+from gubernator_tpu.api.types import (
+    Algorithm,
+    Behavior,
+    HealthCheckResp,
+    RateLimitReq,
+    RateLimitResp,
+)
+from gubernator_tpu.config import MAX_BATCH_SIZE, Config, PeerInfo
+from gubernator_tpu.core.batcher import WindowBatcher
+from gubernator_tpu.core.engine import RateLimitEngine
+from gubernator_tpu.core.global_sync import GlobalManager
+from gubernator_tpu.net.peers import PeerClient
+from gubernator_tpu.observability.metrics import Metrics
+from gubernator_tpu.parallel.router import ConsistentHashRing
+
+HEALTHY = "healthy"
+UNHEALTHY = "unhealthy"
+
+log = logging.getLogger("gubernator.instance")
+
+
+class BatchTooLargeError(Exception):
+    """Maps to gRPC OutOfRange at the transport layer (gubernator.go:78-81)."""
+
+
+class Instance:
+    def __init__(
+        self,
+        config: Optional[Config] = None,
+        mesh=None,
+        engine: Optional[RateLimitEngine] = None,
+        metrics: Optional[Metrics] = None,
+    ):
+        self.conf = config or Config()
+        self.conf.behaviors.validate()
+        self.metrics = metrics or Metrics()
+        e = self.conf.engine
+        self.engine = engine or RateLimitEngine(
+            mesh=mesh,
+            capacity_per_shard=e.capacity_per_shard,
+            batch_per_shard=e.batch_per_shard,
+            global_capacity=e.global_capacity,
+            global_batch_per_shard=e.global_batch_per_shard,
+            max_global_updates=e.max_global_updates,
+        )
+        self.batcher = WindowBatcher(self.engine, self.conf.behaviors, self.metrics)
+        self.global_mgr = GlobalManager(
+            self.conf.behaviors, self, self.metrics, log)
+        self._picker: ConsistentHashRing[PeerClient] = ConsistentHashRing()
+        self.health = HealthCheckResp(status=HEALTHY, peer_count=0)
+        self.advertise_address = self.conf.advertise_address
+
+    # ------------------------------------------------------------ public API
+
+    async def get_rate_limits(self, requests: Sequence[RateLimitReq]) -> List[RateLimitResp]:
+        if len(requests) > MAX_BATCH_SIZE:
+            raise BatchTooLargeError(
+                f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'")
+        return list(await asyncio.gather(*(self._route(r) for r in requests)))
+
+    async def _route(self, r: RateLimitReq) -> RateLimitResp:
+        key = r.hash_key()
+        # validation: exact reference strings and order (gubernator.go:102-110)
+        if not r.unique_key:
+            return RateLimitResp(error="field 'unique_key' cannot be empty")
+        if not r.name:
+            return RateLimitResp(error="field 'namespace' cannot be empty")
+        if r.algorithm not in (Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET):
+            # the reference surfaces this via the apply-error wrapper
+            # (gubernator.go:126-131 <- :250)
+            return RateLimitResp(error=(
+                f"while applying rate limit for '{key}' - "
+                f"'invalid rate limit algorithm '{r.algorithm}''"))
+
+        # standalone (no peer ring): every key is ours
+        if self._picker.size() == 0:
+            return await self._local(r)
+
+        try:
+            peer = self._picker.get(key)
+        except Exception as e:
+            return RateLimitResp(
+                error=f"while finding peer that owns rate limit '{key}' - '{e}'")
+
+        if peer.is_owner:
+            try:
+                return await self._local(r)
+            except Exception as e:
+                return RateLimitResp(
+                    error=f"while applying rate limit for '{key}' - '{e}'")
+
+        if r.behavior == Behavior.GLOBAL:
+            return await self._global_nonowner(r)
+
+        try:
+            resp = await peer.get_peer_rate_limit(r)
+        except Exception as e:
+            return RateLimitResp(
+                error=f"while fetching rate limit '{key}' from peer - '{e}'")
+        # tell the client who coordinates this key (gubernator.go:151)
+        resp.metadata = dict(resp.metadata or {}, owner=peer.host)
+        return resp
+
+    async def _local(self, r: RateLimitReq) -> RateLimitResp:
+        """Owner-side decision through the device engine (the reference's
+        getRateLimit under the cache mutex, gubernator.go:236-251)."""
+        if r.behavior == Behavior.GLOBAL and self._picker.size() > 0:
+            # owner saw a GLOBAL change: schedule an authoritative broadcast
+            # (gubernator.go:240-242)
+            self.global_mgr.queue_update(r)
+        if r.behavior == Behavior.NO_BATCHING:
+            return (await self.batcher.submit_now([r]))[0]
+        return await self.batcher.submit(r)
+
+    async def _global_nonowner(self, r: RateLimitReq) -> RateLimitResp:
+        """Non-owner GLOBAL: answer from the local replica, reconcile hits
+        asynchronously with the owner (gubernator.go:173-195)."""
+        self.global_mgr.queue_hit(r)
+        # replica read through the engine's global arena; hits stay out of
+        # the mesh psum (they reconcile via the owner instead)
+        return await self.batcher.submit(r, accumulate=False)
+
+    # ------------------------------------------------------------ peer plane
+
+    async def get_peer_rate_limits(self, requests: Sequence[RateLimitReq]) -> List[RateLimitResp]:
+        """Batch relay from a peer; we must be authoritative for every key
+        (gubernator.go:210-227)."""
+        if len(requests) > MAX_BATCH_SIZE:
+            raise BatchTooLargeError(
+                f"'PeerRequest.rate_limits' list too large; max size is '{MAX_BATCH_SIZE}'")
+        valid: List[RateLimitReq] = []
+        slots: List[int] = []
+        out: List[Optional[RateLimitResp]] = [None] * len(requests)
+        for i, r in enumerate(requests):
+            if r.algorithm not in (Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET):
+                out[i] = RateLimitResp(
+                    error=f"invalid rate limit algorithm '{r.algorithm}'")
+                continue
+            if r.behavior == Behavior.GLOBAL:
+                self.global_mgr.queue_update(r)
+            valid.append(r)
+            slots.append(i)
+        if valid:
+            resps = await self.batcher.submit_now(valid)
+            for i, resp in zip(slots, resps):
+                out[i] = resp
+        return [o if o is not None else RateLimitResp() for o in out]
+
+    async def update_peer_globals(self, globals_: Sequence) -> None:
+        """Owner pushed authoritative global statuses; upsert our replicas
+        (gubernator.go:199-207)."""
+        await self.batcher.apply_upserts(list(globals_))
+
+    async def read_global_status(self, probe: RateLimitReq) -> RateLimitResp:
+        """Authoritative hits=0 read used by the broadcast loop
+        (global.go:199-203)."""
+        return (await self.batcher.submit_now([probe]))[0]
+
+    async def health_check(self) -> HealthCheckResp:
+        return self.health
+
+    # ------------------------------------------------------------ membership
+
+    def get_peer(self, key: str) -> PeerClient:
+        return self._picker.get(key)
+
+    def peer_list(self) -> List[PeerClient]:
+        return self._picker.peers()
+
+    async def set_peers(self, peers: Sequence[PeerInfo]) -> None:
+        """Rebuild the ring on membership change (gubernator.go:254-292).
+        Unlike the reference (which leaks stale PeerClients, :276 TODO) we
+        close clients for departed hosts."""
+        picker = self._picker.new()
+        errs: List[str] = []
+        for info in peers:
+            client = self._picker.get_by_host(info.address)
+            if client is None:
+                try:
+                    client = PeerClient(self.conf.behaviors, info.address)
+                except Exception:
+                    errs.append(
+                        f"failed to connect to peer '{info.address}'; "
+                        f"consistent hash is incomplete")
+                    continue
+            client.is_owner = info.is_owner
+            picker.add(info.address, client)
+
+        old_hosts = {p.host for p in self._picker.peers()}
+        new_hosts = {p.host for p in picker.peers()}
+        departed = [self._picker.get_by_host(h) for h in old_hosts - new_hosts]
+
+        self._picker = picker
+        self.health = HealthCheckResp(
+            status=UNHEALTHY if errs else HEALTHY,
+            message="|".join(errs),
+            peer_count=picker.size(),
+        )
+        self.global_mgr.start()
+        log.info("Peers updated: %s", [p.address for p in peers])
+        for client in departed:
+            if client is not None:
+                await client.close()
+
+    def close(self) -> None:
+        self.global_mgr.stop()
+        self.batcher.close()

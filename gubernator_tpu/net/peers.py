@@ -1,0 +1,115 @@
+"""Cross-host peer client: gRPC connection + request batching window.
+
+One PeerClient per remote peer, owning the connection and the BATCHING
+aggregation window (reference peers.go:35-207): BATCHING/GLOBAL requests
+queue until batch_limit (1000) or batch_wait (500µs), then ship as one
+GetPeerRateLimits RPC whose responses demux back by index; NO_BATCHING goes
+as an immediate single-item RPC.
+
+This client is only for the *cross-host* plane — peers within one mesh are
+chips and talk via collectives, not RPCs (SURVEY.md §2 parallelism table).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional
+
+import grpc
+
+from gubernator_tpu.api import pb
+from gubernator_tpu.api.grpc_api import PeersV1Stub
+from gubernator_tpu.api.types import Behavior, RateLimitReq, RateLimitResp
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.core.interval import ArmedInterval
+
+
+class PeerClient:
+    def __init__(self, behaviors: BehaviorConfig, host: str):
+        self.host = host
+        self.conf = behaviors
+        self.is_owner = False  # True when this entry names the local instance
+        # insecure channel, like the reference (peers.go:132)
+        self.channel = grpc.aio.insecure_channel(host)
+        self.stub = PeersV1Stub(self.channel)
+        self._pending: List[tuple] = []  # (req, future)
+        self._interval: Optional[ArmedInterval] = None
+        self._waiter: Optional[asyncio.Task] = None
+
+    # ------------------------------------------------------------ forwarding
+
+    async def get_peer_rate_limit(self, req: RateLimitReq) -> RateLimitResp:
+        """Forward one request, batching per behavior (peers.go:73-91)."""
+        if req.behavior in (Behavior.BATCHING, Behavior.GLOBAL):
+            return await self._batched(req)
+        resps = await self.get_peer_rate_limits([req])
+        return resps[0]
+
+    async def get_peer_rate_limits(self, reqs: List[RateLimitReq]) -> List[RateLimitResp]:
+        """One unary batch RPC; validates response length (peers.go:93-105)."""
+        msg = pb.GetPeerRateLimitsReq(requests=[pb.req_to_pb(r) for r in reqs])
+        resp = await self.stub.GetPeerRateLimits(msg, timeout=self.conf.batch_timeout)
+        if len(resp.rate_limits) != len(reqs):
+            raise RuntimeError(
+                "number of rate limits in peer response does not match request")
+        return [pb.resp_from_pb(m) for m in resp.rate_limits]
+
+    async def update_peer_globals(self, globals_: List) -> None:
+        """Push authoritative global statuses (peers.go:107-109)."""
+        msg = pb.UpdatePeerGlobalsReq(globals=[
+            pb.UpdatePeerGlobal(
+                key=g.key,
+                status=pb.resp_to_pb(g.status),
+                algorithm=int(g.algorithm),
+                duration=g.duration,
+            )
+            for g in globals_
+        ])
+        await self.stub.UpdatePeerGlobals(msg, timeout=self.conf.global_timeout)
+
+    # -------------------------------------------------------------- batching
+
+    async def _batched(self, req: RateLimitReq) -> RateLimitResp:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending.append((req, fut))
+        if len(self._pending) >= self.conf.batch_limit:
+            self._flush()
+        elif len(self._pending) == 1:
+            if self._interval is None:
+                self._interval = ArmedInterval(self.conf.batch_wait)
+            self._interval.arm()
+            if self._waiter is None or self._waiter.done():
+                self._waiter = asyncio.create_task(self._wait_interval())
+        return await fut
+
+    async def _wait_interval(self) -> None:
+        await self._interval.wait()
+        if self._pending:
+            self._flush()
+
+    def _flush(self) -> None:
+        window = self._pending
+        self._pending = []
+        asyncio.create_task(self._send_window(window))
+
+    async def _send_window(self, window: List[tuple]) -> None:
+        reqs = [w[0] for w in window]
+        try:
+            resps = await self.get_peer_rate_limits(reqs)
+        except Exception as e:
+            # the whole batch failed; every waiter sees the error
+            # (peers.go:189-196)
+            for _, fut in window:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        for (_, fut), resp in zip(window, resps):
+            if not fut.done():
+                fut.set_result(resp)
+
+    async def close(self) -> None:
+        """Disconnect (the reference leaks old PeerClients on membership
+        churn — gubernator.go:276 TODO; we close them)."""
+        if self._interval is not None:
+            self._interval.stop()
+        await self.channel.close()

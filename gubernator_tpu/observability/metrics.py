@@ -1,0 +1,96 @@
+"""Prometheus metrics with the reference's metric names.
+
+Metric surface parity (SURVEY.md §5):
+  cache_size, cache_access_count{type}          reference cache/lru.go:56-59
+  async_durations, broadcast_durations          reference global.go:44-51
+  grpc_request_counts{status}/{method},
+  grpc_request_duration_milliseconds            reference prometheus.go:52-59
+
+Plus TPU-native additions under guber_tpu_*: device window count, window
+occupancy, device step duration.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+from prometheus_client import CONTENT_TYPE_LATEST  # noqa: F401
+
+
+class Metrics:
+    """Per-instance metric registry (instances in one process each get their
+    own, like each reference node's prometheus.Registry, main.go:53)."""
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry or CollectorRegistry()
+        self.cache_size = Gauge(
+            "cache_size",
+            "Size of the cache which holds the rate limits.",
+            registry=self.registry,
+        )
+        self.cache_access_count = Counter(
+            "cache_access_count",
+            "Cache access counts.",
+            ["type"],
+            registry=self.registry,
+        )
+        self.async_durations = Histogram(
+            "async_durations",
+            "The duration of GLOBAL async sends in seconds.",
+            registry=self.registry,
+        )
+        self.broadcast_durations = Histogram(
+            "broadcast_durations",
+            "The duration of GLOBAL broadcasts to peers in seconds.",
+            registry=self.registry,
+        )
+        self.grpc_request_counts = Counter(
+            "grpc_request_counts",
+            "The count of gRPC requests.",
+            ["status", "method"],
+            registry=self.registry,
+        )
+        self.grpc_request_duration = Histogram(
+            "grpc_request_duration_milliseconds",
+            "The timings of gRPC requests in milliseconds.",
+            ["method"],
+            registry=self.registry,
+        )
+        # TPU-native
+        self.window_count = Counter(
+            "guber_tpu_windows_total",
+            "Device windows dispatched.",
+            registry=self.registry,
+        )
+        self.window_occupancy = Histogram(
+            "guber_tpu_window_occupancy",
+            "Requests per device window.",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000),
+            registry=self.registry,
+        )
+        self.window_duration = Histogram(
+            "guber_tpu_window_duration_seconds",
+            "Wall time of one device window step.",
+            registry=self.registry,
+        )
+
+    def expose(self) -> bytes:
+        return generate_latest(self.registry)
+
+    def observe_rpc(self, method: str, start: float, ok: bool) -> None:
+        """Per-RPC accounting (replaces the reference's gRPC stats-handler
+        channel pipeline, prometheus.go:65-134)."""
+        self.grpc_request_counts.labels(
+            status="success" if ok else "failed", method=method
+        ).inc()
+        self.grpc_request_duration.labels(method=method).observe(
+            (time.monotonic() - start) * 1000.0
+        )

@@ -1,0 +1,93 @@
+"""gRPC server hosting an Instance (V1 + PeersV1 services).
+
+The reference takes a caller-owned *grpc.Server (config.go:30-31) and
+registers onto it (gubernator.go:66-67); here the server wrapper owns a
+grpc.aio server bound to one address, with per-RPC metrics equivalent to the
+reference's stats-handler pipeline (prometheus.go:104-145).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import grpc
+
+from gubernator_tpu.api import pb
+from gubernator_tpu.api.grpc_api import add_peers_servicer, add_v1_servicer
+from gubernator_tpu.core.service import BatchTooLargeError, Instance
+
+
+class _V1Servicer:
+    def __init__(self, instance: Instance):
+        self.instance = instance
+
+    async def GetRateLimits(self, request, context):
+        m = self.instance.metrics
+        start = time.monotonic()
+        try:
+            resps = await self.instance.get_rate_limits(
+                [pb.req_from_pb(r) for r in request.requests])
+        except BatchTooLargeError as e:
+            m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=False)
+            await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
+        m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=True)
+        return pb.GetRateLimitsResp(responses=[pb.resp_to_pb(r) for r in resps])
+
+    async def HealthCheck(self, request, context):
+        h = await self.instance.health_check()
+        return pb.HealthCheckResp(
+            status=h.status, message=h.message, peer_count=h.peer_count)
+
+
+class _PeersServicer:
+    def __init__(self, instance: Instance):
+        self.instance = instance
+
+    async def GetPeerRateLimits(self, request, context):
+        m = self.instance.metrics
+        start = time.monotonic()
+        try:
+            resps = await self.instance.get_peer_rate_limits(
+                [pb.req_from_pb(r) for r in request.requests])
+        except BatchTooLargeError as e:
+            m.observe_rpc("/pb.gubernator.PeersV1/GetPeerRateLimits", start, ok=False)
+            await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
+        m.observe_rpc("/pb.gubernator.PeersV1/GetPeerRateLimits", start, ok=True)
+        return pb.GetPeerRateLimitsResp(
+            rate_limits=[pb.resp_to_pb(r) for r in resps])
+
+    async def UpdatePeerGlobals(self, request, context):
+        from gubernator_tpu.api.types import UpdatePeerGlobal
+        ups = [
+            UpdatePeerGlobal(
+                key=g.key,
+                status=pb.resp_from_pb(g.status),
+                algorithm=g.algorithm,
+                duration=g.duration,
+            )
+            for g in request.globals
+        ]
+        await self.instance.update_peer_globals(ups)
+        return pb.UpdatePeerGlobalsResp()
+
+
+class GrpcServer:
+    def __init__(self, instance: Instance, address: str,
+                 max_message_mb: int = 1):
+        self.instance = instance
+        # 1MB max receive, like the reference (cmd/gubernator/main.go:59-61)
+        self.server = grpc.aio.server(options=[
+            ("grpc.max_receive_message_length", max_message_mb * 1024 * 1024),
+        ])
+        add_v1_servicer(self.server, _V1Servicer(instance))
+        add_peers_servicer(self.server, _PeersServicer(instance))
+        self.port = self.server.add_insecure_port(address)
+        host = address.rsplit(":", 1)[0]
+        self.address = f"{host}:{self.port}"
+
+    async def start(self) -> None:
+        await self.server.start()
+
+    async def stop(self, grace: Optional[float] = 1.0) -> None:
+        await self.server.stop(grace)

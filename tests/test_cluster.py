@@ -1,0 +1,230 @@
+"""Black-box cluster tests over real loopback gRPC.
+
+Mirrors the reference's functional suite (functional_test.go:35-331): a
+multi-node in-process cluster, clients dialing random peers so consistent-
+hash routing and forwarding are exercised implicitly.  Wall-clock dependent
+tables use longer durations than the reference (which sleeps 5-50ms) because
+first-window compiles and a 1-core CI box add jitter.
+"""
+
+import asyncio
+
+import pytest
+
+import gubernator_tpu  # noqa: F401
+from gubernator_tpu import cluster as cluster_mod
+from gubernator_tpu.api.types import (
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    Second,
+    Status,
+)
+from gubernator_tpu.client import AsyncClient
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+@pytest.fixture(scope="module")
+def cluster(loop):
+    c = loop.run_until_complete(cluster_mod.start(4))
+    # warm the device path so timed tests don't eat first-window compiles
+    async def warm():
+        client = AsyncClient(c.get_peer())
+        await client.get_rate_limits([RateLimitReq(
+            name="warmup", unique_key="w", hits=1, limit=1, duration=Second)])
+        await client.close()
+    loop.run_until_complete(warm())
+    yield c
+    loop.run_until_complete(c.stop())
+
+
+def run(loop, coro):
+    return loop.run_until_complete(asyncio.wait_for(coro, timeout=60))
+
+
+def req(name, key, hits=1, limit=2, duration=Second,
+        algo=Algorithm.TOKEN_BUCKET, behavior=Behavior.BATCHING):
+    return RateLimitReq(name=name, unique_key=key, hits=hits, limit=limit,
+                        duration=duration, algorithm=algo, behavior=behavior)
+
+
+def test_health_check(cluster, loop):
+    async def body():
+        client = AsyncClient(cluster.get_peer())
+        h = await client.health_check()
+        assert h.status == "healthy"
+        assert h.peer_count == 4
+        await client.close()
+    run(loop, body())
+
+
+def test_over_the_limit(cluster, loop):
+    # functional_test.go:51-95
+    async def body():
+        client = AsyncClient(cluster.get_peer())
+        expect = [(1, Status.UNDER_LIMIT), (0, Status.UNDER_LIMIT),
+                  (0, Status.OVER_LIMIT)]
+        for remaining, status in expect:
+            rs = await client.get_rate_limits(
+                [req("cl_over_limit", "account:1234")])
+            assert rs[0].status == status
+            assert rs[0].remaining == remaining
+            assert rs[0].limit == 2
+            assert rs[0].reset_time != 0
+            assert rs[0].error == ""
+        await client.close()
+    run(loop, body())
+
+
+def test_token_bucket_expiry(cluster, loop):
+    # functional_test.go:97-146 (longer duration for CI jitter)
+    async def body():
+        client = AsyncClient(cluster.get_peer())
+        r = (await client.get_rate_limits(
+            [req("cl_token", "account:1234", duration=400)]))[0]
+        assert (r.remaining, r.status) == (1, Status.UNDER_LIMIT)
+        r = (await client.get_rate_limits(
+            [req("cl_token", "account:1234", duration=400)]))[0]
+        assert (r.remaining, r.status) == (0, Status.UNDER_LIMIT)
+        await asyncio.sleep(0.5)
+        r = (await client.get_rate_limits(
+            [req("cl_token", "account:1234", duration=400)]))[0]
+        assert (r.remaining, r.status) == (1, Status.UNDER_LIMIT)
+        await client.close()
+    run(loop, body())
+
+
+def test_leaky_bucket(cluster, loop):
+    # functional_test.go:148-206, rate = 2000/5 = 400ms per token
+    async def body():
+        client = AsyncClient(cluster.get_peer())
+        l = lambda hits: req("cl_leaky", "account:1234", hits=hits, limit=5,
+                             duration=2000, algo=Algorithm.LEAKY_BUCKET)
+        r = (await client.get_rate_limits([l(5)]))[0]
+        assert (r.remaining, r.status) == (0, Status.UNDER_LIMIT)
+        r = (await client.get_rate_limits([l(1)]))[0]
+        assert (r.remaining, r.status) == (0, Status.OVER_LIMIT)
+        await asyncio.sleep(0.45)  # one token leaks
+        r = (await client.get_rate_limits([l(1)]))[0]
+        assert (r.remaining, r.status) == (0, Status.UNDER_LIMIT)
+        await asyncio.sleep(0.85)  # two tokens leak
+        r = (await client.get_rate_limits([l(1)]))[0]
+        assert (r.remaining, r.status) == (1, Status.UNDER_LIMIT)
+        assert r.limit == 5
+        await client.close()
+    run(loop, body())
+
+
+def test_missing_fields(cluster, loop):
+    # functional_test.go:208-269 — per-item error strings, not RPC errors
+    async def body():
+        client = AsyncClient(cluster.get_peer())
+        table = [
+            (req("cl_missing", "account:1234", hits=1, limit=10, duration=0),
+             "", Status.UNDER_LIMIT),
+            (req("cl_missing", "account:12345", hits=1, limit=0, duration=10000),
+             "", Status.OVER_LIMIT),
+            (req("", "account:1234", hits=1, limit=5, duration=10000),
+             "field 'namespace' cannot be empty", Status.UNDER_LIMIT),
+            (req("cl_missing", "", hits=1, limit=5, duration=10000),
+             "field 'unique_key' cannot be empty", Status.UNDER_LIMIT),
+        ]
+        for i, (r, err, status) in enumerate(table):
+            rs = await client.get_rate_limits([r])
+            assert rs[0].error == err, i
+            assert rs[0].status == status, i
+        await client.close()
+    run(loop, body())
+
+
+def test_forwarded_requests_carry_owner_metadata(cluster, loop):
+    # gubernator.go:151: non-owner responses name the owner
+    async def body():
+        key = "cl_owner_meta_account:42"
+        owner_idx = await cluster.owner_index_of("cl_owner_meta_" + "account:42")
+        non_owner = (owner_idx + 1) % len(cluster.addresses)
+        client = AsyncClient(cluster.peer_at(non_owner))
+        rs = await client.get_rate_limits(
+            [req("cl_owner_meta", "account:42", limit=10)])
+        assert rs[0].metadata.get("owner") == cluster.peer_at(owner_idx)
+        await client.close()
+    run(loop, body())
+
+
+def test_batch_too_large_is_rpc_error(cluster, loop):
+    # gubernator.go:78-81: >1000 items rejects the whole RPC
+    import grpc
+    async def body():
+        client = AsyncClient(cluster.get_peer())
+        reqs = [req("cl_too_big", f"k{i}", limit=10) for i in range(1001)]
+        try:
+            await client.get_rate_limits(reqs)
+            assert False, "expected OUT_OF_RANGE"
+        except grpc.aio.AioRpcError as e:
+            assert e.code() == grpc.StatusCode.OUT_OF_RANGE
+            assert "max size is '1000'" in e.details()
+        await client.close()
+    run(loop, body())
+
+
+def test_global_rate_limits(cluster, loop):
+    # functional_test.go:271-331: drive GLOBAL against a non-owner peer;
+    # stale-then-consistent remaining, then metric sample counts.
+    async def body():
+        full_key = "cl_global_" + "account:1234"
+        owner_idx = await cluster.owner_index_of(full_key)
+        non_owner_idx = (owner_idx + 1) % len(cluster.addresses)
+        client = AsyncClient(cluster.peer_at(non_owner_idx))
+
+        g = req("cl_global", "account:1234", hits=1, limit=5,
+                duration=3 * Second, behavior=Behavior.GLOBAL)
+
+        async def send_hit(expect_remaining, i):
+            rs = await client.get_rate_limits([g])
+            assert rs[0].error == "", i
+            assert rs[0].status == Status.UNDER_LIMIT, i
+            assert rs[0].remaining == expect_remaining, i
+            assert rs[0].limit == 5, i
+
+        # first hit bootstraps the replica and queues the async forward
+        await send_hit(4, 1)
+        # async forward hasn't reconciled: same answer (functional_test.go:304)
+        await send_hit(4, 2)
+        await asyncio.sleep(1.0)
+        # owner applied both hits and broadcast the authoritative status
+        await send_hit(3, 3)
+
+        # metrics: the non-owner recorded an async send, the owner a broadcast
+        non_owner = cluster.instance_at(non_owner_idx)
+        assert _hist_count(non_owner, "async_durations") >= 1
+        owner = cluster.instance_at(owner_idx)
+        assert _hist_count(owner, "broadcast_durations") >= 1
+        await client.close()
+    run(loop, body())
+
+
+def _hist_count(instance, name: str) -> float:
+    for fam in instance.metrics.registry.collect():
+        if fam.name == name:
+            for sample in fam.samples:
+                if sample.name == name + "_count":
+                    return sample.value
+    return 0.0
+
+
+def test_no_batching_behavior(cluster, loop):
+    async def body():
+        client = AsyncClient(cluster.get_peer())
+        n = req("cl_nobatch", "k", hits=1, limit=3,
+                behavior=Behavior.NO_BATCHING)
+        rs = await client.get_rate_limits([n, n])
+        # two items in one RPC still serialize correctly
+        assert sorted([rs[0].remaining, rs[1].remaining]) == [1, 2]
+        await client.close()
+    run(loop, body())

@@ -1,0 +1,98 @@
+"""HTTP JSON gateway tests (the reference's grpc-gateway surface,
+gubernator.pb.gw.go:59-148 + /metrics, cmd/gubernator/main.go:113-116)."""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+import gubernator_tpu  # noqa: F401
+from gubernator_tpu.api.http_gateway import build_app
+from gubernator_tpu.config import Config, EngineConfig
+from gubernator_tpu.core.service import Instance
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+@pytest.fixture(scope="module")
+def http(loop):
+    conf = Config(engine=EngineConfig(
+        capacity_per_shard=512, batch_per_shard=128,
+        global_capacity=128, global_batch_per_shard=32, max_global_updates=32))
+    inst = Instance(conf)
+    client = loop.run_until_complete(_make_client(inst))
+    yield client
+    loop.run_until_complete(client.close())
+    inst.close()
+
+
+async def _make_client(inst):
+    server = TestServer(build_app(inst))
+    client = TestClient(server)
+    await client.start_server()
+    return client
+
+
+def test_get_rate_limits_json(http, loop):
+    async def body():
+        payload = {
+            "requests": [{
+                "name": "http_test",
+                "uniqueKey": "account:1234",
+                "hits": "1",
+                "limit": "2",
+                "duration": "1000",
+            }]
+        }
+        r = await http.post("/v1/GetRateLimits", json=payload)
+        assert r.status == 200
+        data = await r.json()
+        # proto3 JSON: int64 as strings, enums as names, defaults omitted
+        assert data["responses"][0]["limit"] == "2"
+        assert data["responses"][0]["remaining"] == "1"
+        r = await http.post("/v1/GetRateLimits", json=payload)
+        data = await r.json()
+        assert data["responses"][0].get("remaining") is None  # 0 omitted
+        r = await http.post("/v1/GetRateLimits", json=payload)
+        data = await r.json()
+        assert data["responses"][0]["status"] == "OVER_LIMIT"
+    loop.run_until_complete(body())
+
+
+def test_validation_error_json(http, loop):
+    async def body():
+        r = await http.post("/v1/GetRateLimits", json={
+            "requests": [{"name": "x", "hits": "1", "limit": "5"}]})
+        data = await r.json()
+        assert data["responses"][0]["error"] == "field 'unique_key' cannot be empty"
+    loop.run_until_complete(body())
+
+
+def test_malformed_json_rejected(http, loop):
+    async def body():
+        r = await http.post("/v1/GetRateLimits", data=b"{nonsense")
+        assert r.status == 400
+    loop.run_until_complete(body())
+
+
+def test_health_check(http, loop):
+    async def body():
+        r = await http.get("/v1/HealthCheck")
+        assert r.status == 200
+        data = await r.json()
+        assert data["status"] == "healthy"
+    loop.run_until_complete(body())
+
+
+def test_metrics_endpoint(http, loop):
+    async def body():
+        r = await http.get("/metrics")
+        text = await r.text()
+        assert "cache_access_count" in text
+        assert "guber_tpu_windows_total" in text
+    loop.run_until_complete(body())
