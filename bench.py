@@ -1,0 +1,146 @@
+"""Benchmark: rate-limit decisions/sec/chip on the device window engine.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Measures the production device step (the same jitted shard_map computation
+RateLimitEngine dispatches every batching window) in steady state on a
+1-chip mesh: mixed TOKEN+LEAKY buckets over a 1M-slot arena with Zipf(1.1)
+hot-key skew — the shape of BASELINE.md eval configs (2)/(3).  Windows are
+pre-packed on device so the number reflects the decision engine itself, not
+Python host packing (reported separately on stderr for context).
+
+vs_baseline compares against the reference's published single-node
+throughput: >2,000 client requests/sec in production (README.md:94-99 — its
+only headline throughput number; see BASELINE.md).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import gubernator_tpu  # noqa: F401
+    from gubernator_tpu.core.engine import RateLimitEngine, _compiled_step
+    from gubernator_tpu.ops import kernel
+    from gubernator_tpu.parallel.mesh import make_mesh
+
+    dev = jax.devices()[0]
+    print(f"# backend: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    CAPACITY = 1 << 20  # 1M slots resident in HBM
+    LANES = 8192  # decisions per window
+    N_WINDOWS = 16  # distinct pre-packed windows, cycled
+    WARMUP = 5
+    ITERS = 200
+
+    mesh = make_mesh(jax.devices()[:1])
+    eng = RateLimitEngine(
+        mesh=mesh,
+        capacity_per_shard=CAPACITY,
+        batch_per_shard=LANES,
+        global_capacity=1024,
+        global_batch_per_shard=128,
+        max_global_updates=128,
+    )
+    step = eng._step_fn
+
+    # Zipf(1.1) slot distribution over the arena (hot-key skew), mixed algos.
+    rng = np.random.default_rng(7)
+    zipf = rng.zipf(1.1, size=(N_WINDOWS, LANES))
+    slots = ((zipf - 1) % CAPACITY).astype(np.int32)
+
+    def pack(i):
+        s = slots[i]
+        return kernel.WindowBatch(
+            slot=jnp.asarray(s[None, :]),
+            hits=jnp.ones((1, LANES), jnp.int64),
+            limit=jnp.full((1, LANES), 1_000_000, jnp.int64),
+            duration=jnp.full((1, LANES), 60_000, jnp.int64),
+            algo=jnp.asarray((s % 2).astype(np.int32)[None, :]),
+            is_init=jnp.zeros((1, LANES), bool),
+        )
+
+    batches = [jax.device_put(pack(i)) for i in range(N_WINDOWS)]
+    empty_g = jax.device_put(kernel.WindowBatch(*[
+        a[None, :] for a in kernel.WindowBatch.pad(eng.global_batch_per_shard)
+    ]))
+    gacc = jax.device_put(jnp.zeros((1, eng.global_batch_per_shard), jnp.int64))
+    G = eng.global_capacity
+    Kg = eng.max_global_updates
+    upd = jax.device_put((
+        jnp.full((Kg,), G, jnp.int32), jnp.zeros((Kg,), jnp.int64),
+        jnp.zeros((Kg,), jnp.int64), jnp.zeros((Kg,), jnp.int32),
+        jnp.full((Kg,), G, jnp.int32),
+    ))
+    ups = jax.device_put((
+        jnp.full((Kg,), G, jnp.int32), jnp.zeros((Kg,), jnp.int64),
+        jnp.zeros((Kg,), jnp.int64), jnp.zeros((Kg,), jnp.int64),
+        jnp.zeros((Kg,), jnp.int64), jnp.zeros((Kg,), jnp.int64),
+        jnp.zeros((Kg,), jnp.int32),
+    ))
+
+    state, gstate, gcfg = eng.state, eng.gstate, eng.gcfg
+    now = 1_700_000_000_000
+
+    def run_one(i, state, gstate, gcfg, t):
+        return step(state, gstate, gcfg, batches[i % N_WINDOWS], empty_g,
+                    gacc, upd, ups, jnp.int64(t))
+
+    # warmup (compile + arena fill)
+    for i in range(WARMUP):
+        state, out, gstate, gcfg, _ = run_one(i, state, gstate, gcfg, now + i)
+    jax.block_until_ready(out)
+
+    lat = []
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        w0 = time.perf_counter()
+        state, out, gstate, gcfg, _ = run_one(i, state, gstate, gcfg,
+                                              now + WARMUP + i)
+        # per-window latency includes the device sync a real serving window
+        # pays before demuxing responses
+        jax.block_until_ready(out)
+        lat.append(time.perf_counter() - w0)
+    total = time.perf_counter() - t0
+
+    decisions = ITERS * LANES
+    per_sec = decisions / total
+    lat_ms = np.array(lat) * 1000.0
+    print(
+        f"# windows: {ITERS} x {LANES} lanes; window p50={np.percentile(lat_ms, 50):.3f}ms "
+        f"p99={np.percentile(lat_ms, 99):.3f}ms; capacity={CAPACITY}",
+        file=sys.stderr,
+    )
+
+    # hand the final (donated-through) buffers back to the engine
+    eng.state, eng.gstate, eng.gcfg = state, gstate, gcfg
+
+    # context: host-path throughput through the full engine (Python packing)
+    from gubernator_tpu.api.types import RateLimitReq
+    reqs = [RateLimitReq(name="b", unique_key=f"k{i}", hits=1, limit=100,
+                         duration=60_000) for i in range(1000)]
+    eng.process(reqs, now=now)  # warm slot table
+    h0 = time.perf_counter()
+    H = 5
+    for i in range(H):
+        eng.process(reqs, now=now + i)
+    host_per_sec = H * len(reqs) / (time.perf_counter() - h0)
+    print(f"# host-packed path: {host_per_sec:,.0f} decisions/sec", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "rate_limit_decisions_per_sec_per_chip",
+        "value": round(per_sec, 1),
+        "unit": "decisions/s",
+        "vs_baseline": round(per_sec / 2000.0, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

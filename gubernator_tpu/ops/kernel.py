@@ -288,6 +288,13 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
     seg_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), s_slot[1:] != s_slot[:-1]])
     seg_start_idx = lax.cummax(jnp.where(seg_start, idx, jnp.int32(0)))
     pos = idx - seg_start_idx
+    # seg_len[i] = length of i's segment: next segment start minus own start
+    shifted = jnp.concatenate([
+        jnp.where(seg_start[1:], idx[1:], jnp.int32(B)),
+        jnp.full((1,), B, I32),
+    ])
+    next_start = jnp.flip(lax.cummin(jnp.flip(shifted)))
+    seg_len = next_start - seg_start_idx
 
     # Registers: the live state of each segment's bucket, stored at the
     # segment-start position.  Initialized from the arena.
@@ -305,21 +312,88 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
     # per-round against the live register.
     cur_fresh = s_init | (cur.expire < now)
 
-    # zeros_like keeps the buffers device-varying under shard_map (each shard
-    # owns its own response lanes) — plain jnp.zeros would be replicated and
-    # trip the while_loop carry vma check.
-    outs = WindowOutput(
-        status=jnp.zeros_like(s_algo),
-        limit=jnp.zeros_like(s_hits),
-        remaining=jnp.zeros_like(s_hits),
-        reset_time=jnp.zeros_like(s_hits),
+    # ---- closed-form fast path for UNIFORM segments --------------------
+    # A hot key's duplicates are usually identical requests (same hits>0 and
+    # config).  The greedy use-it-or-lose-it sequence then has a closed form:
+    # the first k* = min(len, r_start // h) lanes decrement, the rest reject
+    # without mutating — matching algorithms.go:51-65/:136-148 item by item.
+    # Only *irregular* segments (mixed hits/config, zero-hit reads,
+    # mid-segment slot recycling) fall back to the replay rounds below, so a
+    # Zipf-skewed window no longer pays one round per duplicate.
+    h0 = s_hits[seg_start_idx]
+    l0 = s_limit[seg_start_idx]
+    d0 = s_duration[seg_start_idx]
+    a0 = s_algo[seg_start_idx]
+    lane_ok = (
+        (s_hits == h0) & (s_limit == l0) & (s_duration == d0)
+        & (s_algo == a0) & ~(s_init & (pos > 0))
+    )
+    seg_ok = jnp.ones_like(s_algo).at[seg_start_idx].min(
+        lane_ok.astype(I32), mode="drop")
+    seg_uniform = (seg_ok[seg_start_idx] == 1) & (h0 > 0)
+
+    st_L = cur.limit[seg_start_idx]
+    st_D = cur.duration[seg_start_idx]
+    st_R = cur.remaining[seg_start_idx]
+    st_T = cur.tstamp[seg_start_idx]
+    st_E = cur.expire[seg_start_idx]
+    st_A = cur.algo[seg_start_idx]
+    fresh0 = cur_fresh[seg_start_idx] | (a0 != st_A)
+    is_token0 = a0 == TOKEN_BUCKET
+    init_over0 = h0 > l0
+
+    L_eff = jnp.where(fresh0, l0, st_L)
+    D_eff = jnp.where(fresh0, d0, st_D)
+    # token: reset_time is now+duration on init, stored otherwise
+    T0_tok = jnp.where(fresh0, now + d0, st_T)
+    rate0 = jnp.maximum(D_eff // jnp.maximum(l0, jnp.int64(1)), jnp.int64(1))
+    leak0 = jnp.where(fresh0, jnp.int64(0), (now - st_T) // rate0)
+    r_start_tok = jnp.where(fresh0, jnp.where(init_over0, jnp.int64(0), l0), st_R)
+    r_start_lky = jnp.where(
+        fresh0,
+        jnp.where(init_over0, jnp.int64(0), l0),
+        jnp.minimum(st_R + leak0, L_eff),
+    )
+    r_start = jnp.where(is_token0, r_start_tok, r_start_lky)
+    kstar = jnp.minimum(seg_len.astype(I64), r_start // h0)
+    r_end = r_start - kstar * h0
+
+    posl = pos.astype(I64)
+    under = posl < kstar
+    ff_rem = jnp.where(under, r_start - (posl + 1) * h0, r_end)
+    ff_status = jnp.where(under, UNDER_LIMIT, OVER_LIMIT).astype(I32)
+    # leaky: UNDER lanes report 0; OVER lanes report now+rate — except the
+    # very first lane of a fresh bucket, whose init response is always 0
+    # (algorithms.go:169-181)
+    lky_reset = jnp.where(
+        under | (fresh0 & (pos == 0)), jnp.int64(0), now + rate0)
+    ff_reset = jnp.where(is_token0, T0_tok, lky_reset)
+    ff_out = WindowOutput(
+        status=ff_status, limit=L_eff, remaining=ff_rem, reset_time=ff_reset)
+
+    consumed = kstar >= 1
+    ff_reg = _Reg(
+        limit=L_eff,
+        duration=D_eff,
+        remaining=r_end,
+        tstamp=jnp.where(is_token0, T0_tok, now),
+        expire=jnp.where(
+            is_token0,
+            jnp.where(fresh0, now + d0, st_E),
+            jnp.where(fresh0 | consumed, now + d0, st_E),
+        ),
+        algo=a0,
     )
 
-    max_pos = jnp.max(jnp.where(s_valid, pos, jnp.int32(0)))
+    # replay buffers start from the fast-path answers; replay rounds only
+    # overwrite lanes of non-uniform segments
+    outs = ff_out
+
+    max_pos = jnp.max(jnp.where(s_valid & ~seg_uniform, pos, jnp.int32(-1)))
 
     def round_body(carry):
         p, cur, cur_fresh, outs = carry
-        active = (pos == p) & s_valid
+        active = (pos == p) & s_valid & ~seg_uniform
         reg = jax.tree.map(lambda a: a[seg_start_idx], cur)
         reg = _Reg(*reg)
         # fresh: segment-level miss (expired/new at window start), an
@@ -350,14 +424,18 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
 
     # Commit final segment registers back to the arena (one write per touched
     # slot — the window's net effect, like the mutex-serialized mutations).
+    # Uniform segments commit their closed-form state; replayed segments
+    # commit the live register.
+    fin = _Reg(*jax.tree.map(
+        lambda f, c: jnp.where(seg_uniform, f, c), ff_reg, cur))
     wslot = jnp.where(seg_start & s_valid, s_slot, jnp.int32(C))
     new_state = BucketState(
-        limit=state.limit.at[wslot].set(cur.limit, mode="drop"),
-        duration=state.duration.at[wslot].set(cur.duration, mode="drop"),
-        remaining=state.remaining.at[wslot].set(cur.remaining, mode="drop"),
-        tstamp=state.tstamp.at[wslot].set(cur.tstamp, mode="drop"),
-        expire=state.expire.at[wslot].set(cur.expire, mode="drop"),
-        algo=state.algo.at[wslot].set(cur.algo, mode="drop"),
+        limit=state.limit.at[wslot].set(fin.limit, mode="drop"),
+        duration=state.duration.at[wslot].set(fin.duration, mode="drop"),
+        remaining=state.remaining.at[wslot].set(fin.remaining, mode="drop"),
+        tstamp=state.tstamp.at[wslot].set(fin.tstamp, mode="drop"),
+        expire=state.expire.at[wslot].set(fin.expire, mode="drop"),
+        algo=state.algo.at[wslot].set(fin.algo, mode="drop"),
     )
 
     # Un-sort responses back to arrival order.

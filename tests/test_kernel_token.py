@@ -191,12 +191,49 @@ def test_window_init_with_duplicates_first_over():
 
 
 def test_many_duplicates_deep_replay():
+    # uniform segment -> exercised by the closed-form fast path
     h = KernelHarness()
     rs = h.window([req(hits=1, limit=10) for _ in range(15)])
     under = [r for r in rs if r.status == Status.UNDER_LIMIT]
     over = [r for r in rs if r.status == Status.OVER_LIMIT]
     assert len(under) == 10 and len(over) == 5
     assert [r.remaining for r in rs[:11]] == [9, 8, 7, 6, 5, 4, 3, 2, 1, 0, 0]
+
+
+def test_uniform_hits_gt_one_closed_form():
+    # uniform hits=3 over limit 10: two decrements then rejects with the
+    # leftover remaining (algorithms.go:57-62)
+    h = KernelHarness()
+    rs = h.window([req(hits=3, limit=10) for _ in range(4)])
+    assert [(r.status, r.remaining) for r in rs] == [
+        (Status.UNDER_LIMIT, 7),
+        (Status.UNDER_LIMIT, 4),
+        (Status.UNDER_LIMIT, 1),
+        (Status.OVER_LIMIT, 1),
+    ]
+    # a later smaller ask still succeeds (state kept the leftover 1)
+    r = h.one(req(hits=1, limit=10))
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 0)
+
+
+def test_uniform_and_irregular_segments_coexist():
+    # one hot uniform key + one irregular key (zero-hit read mixed in) in the
+    # same window: fast path and replay must not interfere
+    h = KernelHarness()
+    a = lambda hits: req(key="hot", hits=hits, limit=5)
+    b = lambda hits: req(key="odd", hits=hits, limit=4)
+    rs = h.window([a(1), b(2), a(1), b(0), a(1), b(1), a(1)])
+    assert [r.remaining for r in rs if r.limit == 5] == [4, 3, 2, 1]
+    assert [r.remaining for r in rs if r.limit == 4] == [2, 2, 1]
+
+
+def test_uniform_segment_init_over_ask():
+    # fresh key, uniform hits > limit: init stores remaining 0 and every
+    # lane is OVER (algorithms.go:77-83)
+    h = KernelHarness()
+    rs = h.window([req(hits=9, limit=5) for _ in range(3)])
+    assert all(r.status == Status.OVER_LIMIT for r in rs)
+    assert all(r.remaining == 0 for r in rs)
 
 
 def test_in_window_slot_reuse_after_eviction():
