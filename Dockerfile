@@ -1,0 +1,18 @@
+# gubernator-tpu server image (parity with the reference's Dockerfile:1-37,
+# adapted: a Python/JAX service can't be FROM scratch).  For TPU serving use
+# a TPU-enabled base (e.g. a jax[tpu] image on a TPU VM host).
+FROM python:3.12-slim
+
+RUN pip install --no-cache-dir "jax[cpu]" aiohttp grpcio protobuf prometheus-client
+
+WORKDIR /app
+COPY gubernator_tpu/ gubernator_tpu/
+COPY setup.py README.md ./
+RUN pip install --no-cache-dir -e .
+
+# same two ports as the reference: 80 http, 81 grpc
+ENV GUBER_HTTP_ADDRESS=0.0.0.0:80 \
+    GUBER_GRPC_ADDRESS=0.0.0.0:81
+EXPOSE 80 81
+
+ENTRYPOINT ["python", "-m", "gubernator_tpu.daemon"]

@@ -1,0 +1,19 @@
+# Dev targets (the reference Makefile:1-15 has only release/docker; we add
+# the working set).
+
+.PHONY: test proto bench docker lint cluster
+
+test:
+	python -m pytest tests/ -x -q
+
+proto:
+	cd gubernator_tpu/api/proto && protoc --python_out=. gubernator.proto peers.proto
+
+bench:
+	python bench.py
+
+docker:
+	docker build -t gubernator-tpu:latest .
+
+cluster:
+	python -m gubernator_tpu.cmd.cluster_main

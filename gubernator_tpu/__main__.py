@@ -1,0 +1,3 @@
+from gubernator_tpu.daemon import main
+
+main()

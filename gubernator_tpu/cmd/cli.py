@@ -1,0 +1,58 @@
+"""Load-generation CLI: random token-bucket limits hammered in a loop.
+
+Equivalent of the reference's cmd/gubernator-cli (main.go:42-85): generate
+2000 random rate-limit configs, hit them forever with concurrency 10, print
+any OVER_LIMIT responses.
+
+Run: python -m gubernator_tpu.cmd.cli <address>
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import random
+
+from gubernator_tpu.api.types import Algorithm, RateLimitReq, Second, Status
+from gubernator_tpu.client import AsyncClient, random_string
+
+
+async def _amain(address: str, count: int, concurrency: int) -> None:
+    client = AsyncClient(address)
+    reqs = [
+        RateLimitReq(
+            name=random_string("ID-", 6),
+            unique_key=random_string("ID-", 10),
+            hits=1,
+            limit=random.randint(1, 10),
+            duration=random.randint(1, 10) * Second,
+            algorithm=Algorithm.TOKEN_BUCKET,
+        )
+        for _ in range(count)
+    ]
+    sem = asyncio.Semaphore(concurrency)
+
+    async def hit(req: RateLimitReq) -> None:
+        async with sem:
+            resps = await client.get_rate_limits([req], timeout=0.5)
+            if resps[0].status == Status.OVER_LIMIT:
+                print(resps[0])
+
+    while True:
+        await asyncio.gather(*(hit(r) for r in reqs))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("gubernator-tpu-cli")
+    p.add_argument("address", nargs="?", default="127.0.0.1:9090")
+    p.add_argument("--count", type=int, default=2000)
+    p.add_argument("--concurrency", type=int, default=10)
+    args = p.parse_args()
+    try:
+        asyncio.run(_amain(args.address, args.count, args.concurrency))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

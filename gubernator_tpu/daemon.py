@@ -1,0 +1,146 @@
+"""Server daemon: the composition root.
+
+Equivalent of the reference's cmd/gubernator/main.go:40-140: env config,
+device engine (in place of the LRU cache), gRPC server, discovery pool
+(k8s | etcd | static), HTTP gateway with /metrics, SIGINT/SIGTERM graceful
+shutdown.  Run as `python -m gubernator_tpu.daemon` (flags: --config
+<env-file>, --debug — the reference's only two flags,
+cmd/gubernator/config.go:63-66).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+from typing import Optional
+
+from gubernator_tpu.config import (
+    BehaviorConfig,
+    Config,
+    DaemonConfig,
+    config_from_env,
+)
+from gubernator_tpu.api.http_gateway import HttpGateway
+from gubernator_tpu.core.service import Instance
+from gubernator_tpu.server import GrpcServer
+
+log = logging.getLogger("gubernator.daemon")
+
+
+def apply_platform_env() -> None:
+    """Honor GUBER_JAX_PLATFORM (e.g. 'cpu', 'tpu') before first device use.
+
+    Needed because ambient JAX_PLATFORMS may be pinned by site config; this
+    routes through jax.config which wins over the environment."""
+    import os
+    platform = os.environ.get("GUBER_JAX_PLATFORM")
+    if platform:
+        import jax
+        jax.config.update("jax_platforms", platform)
+
+
+class Daemon:
+    def __init__(self, conf: DaemonConfig):
+        self.conf = conf
+        self.instance: Optional[Instance] = None
+        self.grpc: Optional[GrpcServer] = None
+        self.http: Optional[HttpGateway] = None
+        self.pool = None
+
+    async def start(self) -> None:
+        c = self.conf
+        apply_platform_env()
+        self.instance = Instance(Config(
+            behaviors=c.behaviors,
+            engine=c.engine,
+            advertise_address=c.advertise_address,
+        ))
+        # compile the device step before accepting traffic
+        self.instance.engine.step([])
+
+        self.grpc = GrpcServer(self.instance, c.grpc_listen_address)
+        await self.grpc.start()
+        log.info("gRPC listening on %s", self.grpc.address)
+
+        import os
+        static_peers = os.environ.get("GUBER_STATIC_PEERS", "")
+        if c.k8s_enabled:
+            from gubernator_tpu.discovery.kubernetes import K8sPool
+            self.pool = K8sPool(
+                namespace=c.k8s_namespace,
+                pod_ip=c.k8s_pod_ip,
+                pod_port=c.k8s_pod_port,
+                selector=c.k8s_endpoints_selector,
+                on_update=self.instance.set_peers,
+            )
+            await self.pool.start()
+        elif c.etcd_enabled:
+            from gubernator_tpu.discovery.etcd import EtcdPool
+            self.pool = EtcdPool(
+                endpoints=c.etcd_addresses,
+                advertise_address=c.advertise_address,
+                on_update=self.instance.set_peers,
+                prefix=c.etcd_prefix,
+                username=c.etcd_username,
+                password=c.etcd_password,
+            )
+            await self.pool.start()
+        elif static_peers:
+            from gubernator_tpu.discovery.static import StaticPool
+            self.pool = StaticPool(
+                addresses=[a.strip() for a in static_peers.split(",") if a.strip()],
+                advertise_address=c.advertise_address,
+                on_update=self.instance.set_peers,
+            )
+            await self.pool.start()
+
+        self.http = HttpGateway(self.instance, c.http_listen_address)
+        await self.http.start()
+        log.info("HTTP gateway listening on %s", c.http_listen_address)
+
+    async def stop(self) -> None:
+        # shutdown order mirrors main.go:127-139: discovery, http, grpc
+        if self.pool is not None:
+            await self.pool.close()
+        if self.http is not None:
+            await self.http.stop()
+        if self.grpc is not None:
+            await self.grpc.stop()
+        if self.instance is not None:
+            self.instance.close()
+
+
+async def _amain(conf: DaemonConfig) -> None:
+    daemon = Daemon(conf)
+    await daemon.start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    log.info("caught signal; shutting down")
+    await daemon.stop()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("gubernator-tpu")
+    p.add_argument("--config", dest="config_file", default=None,
+                   help="environment config file (KEY=value lines)")
+    p.add_argument("--debug", action="store_true")
+    args = p.parse_args(argv)
+
+    conf = config_from_env(args.config_file)
+    import os
+    if args.debug or conf.debug:
+        logging.basicConfig(level=logging.DEBUG)
+        log.debug("debug enabled")
+    else:
+        logging.basicConfig(level=logging.INFO)
+
+    asyncio.run(_amain(conf))
+
+
+if __name__ == "__main__":
+    main()

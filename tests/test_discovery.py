@@ -1,0 +1,178 @@
+"""Discovery backend tests against fake control planes.
+
+The reference tests none of its discovery code; we at least drive EtcdPool
+against an in-process fake speaking the etcd v3 JSON gateway protocol
+(register/lease/watch/delete), and K8sPool's Endpoints parsing.
+"""
+
+import asyncio
+import base64
+import json
+
+import pytest
+from aiohttp import web
+
+import gubernator_tpu  # noqa: F401
+from gubernator_tpu.config import PeerInfo
+from gubernator_tpu.discovery.etcd import EtcdPool
+from gubernator_tpu.discovery.kubernetes import K8sPool
+
+
+def b64(s: str) -> str:
+    return base64.b64encode(s.encode()).decode()
+
+
+class FakeEtcd:
+    """Minimal v3 JSON gateway: kv put/range/deleterange, lease grant,
+    streaming watch."""
+
+    def __init__(self):
+        self.kv = {}
+        self.lease_seq = 100
+        self.watchers = []
+        app = web.Application()
+        app.router.add_post("/v3/lease/grant", self.lease_grant)
+        app.router.add_post("/v3/lease/keepalive", self.keepalive)
+        app.router.add_post("/v3/lease/revoke", self.revoke)
+        app.router.add_post("/v3/kv/put", self.put)
+        app.router.add_post("/v3/kv/range", self.range)
+        app.router.add_post("/v3/kv/deleterange", self.deleterange)
+        app.router.add_post("/v3/watch", self.watch)
+        self.app = app
+
+    async def lease_grant(self, req):
+        self.lease_seq += 1
+        return web.json_response({"ID": str(self.lease_seq), "TTL": "30"})
+
+    async def keepalive(self, req):
+        return web.json_response({"result": {"TTL": "30"}})
+
+    async def revoke(self, req):
+        return web.json_response({})
+
+    async def put(self, req):
+        body = await req.json()
+        self.kv[body["key"]] = body["value"]
+        await self.notify("PUT", body["key"], body["value"])
+        return web.json_response({})
+
+    async def range(self, req):
+        kvs = [{"key": k, "value": v} for k, v in sorted(self.kv.items())]
+        return web.json_response({"kvs": kvs})
+
+    async def deleterange(self, req):
+        body = await req.json()
+        v = self.kv.pop(body["key"], None)
+        if v is not None:
+            await self.notify("DELETE", body["key"], "")
+        return web.json_response({})
+
+    async def notify(self, type_, key, value):
+        ev = {"result": {"events": [
+            {"type": type_, "kv": {"key": key, "value": value}}]}}
+        line = (json.dumps(ev) + "\n").encode()
+        for resp in list(self.watchers):
+            try:
+                await resp.write(line)
+            except Exception:
+                self.watchers.remove(resp)
+
+    async def watch(self, req):
+        resp = web.StreamResponse()
+        await resp.prepare(req)
+        self.watchers.append(resp)
+        # keep the stream open until the client disconnects
+        try:
+            while True:
+                await asyncio.sleep(3600)
+        except asyncio.CancelledError:
+            raise
+        finally:
+            if resp in self.watchers:
+                self.watchers.remove(resp)
+
+
+def test_etcd_pool_register_watch():
+    async def body():
+        fake = FakeEtcd()
+        runner = web.AppRunner(fake.app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+
+        updates = []
+
+        async def on_update(peers):
+            updates.append(sorted(p.address for p in peers))
+
+        pool = EtcdPool(
+            endpoints=[f"http://127.0.0.1:{port}"],
+            advertise_address="10.0.0.1:81",
+            on_update=on_update,
+        )
+        await pool.start()
+        # initial collect includes our own registration
+        assert updates[-1] == ["10.0.0.1:81"]
+        # let the watch stream connect (the fake has no revision replay)
+        for _ in range(50):
+            if fake.watchers:
+                break
+            await asyncio.sleep(0.02)
+
+        # a second node registers -> watch event fires an update
+        await fake.put_key("/gubernator/peers/10.0.0.2:81", "10.0.0.2:81")
+        await asyncio.sleep(0.2)
+        assert updates[-1] == ["10.0.0.1:81", "10.0.0.2:81"]
+
+        # it departs (lease expiry == DELETE)
+        await fake.del_key("/gubernator/peers/10.0.0.2:81")
+        await asyncio.sleep(0.2)
+        assert updates[-1] == ["10.0.0.1:81"]
+
+        # self-identification
+        await pool._fire()
+        await pool.close()
+        await runner.cleanup()
+
+    asyncio.new_event_loop().run_until_complete(body())
+
+
+# direct-manipulation helpers for the fake
+async def _put_key(self, key, value):
+    self.kv[b64(key)] = b64(value)
+    await self.notify("PUT", b64(key), b64(value))
+
+
+async def _del_key(self, key):
+    self.kv.pop(b64(key), None)
+    await self.notify("DELETE", b64(key), "")
+
+
+FakeEtcd.put_key = _put_key
+FakeEtcd.del_key = _del_key
+
+
+def test_k8s_endpoints_parsing():
+    async def body():
+        updates = []
+
+        async def on_update(peers):
+            updates.append(peers)
+
+        pool = K8sPool(
+            namespace="default", pod_ip="10.1.0.5", pod_port="81",
+            selector="app=guber", on_update=on_update,
+            api_base="http://unused", token="t",
+        )
+        await pool._update_from([{
+            "subsets": [{
+                "addresses": [{"ip": "10.1.0.5"}, {"ip": "10.1.0.6"}],
+            }],
+        }])
+        peers = updates[-1]
+        assert [p.address for p in peers] == ["10.1.0.5:81", "10.1.0.6:81"]
+        assert [p.is_owner for p in peers] == [True, False]
+        await pool.close()
+
+    asyncio.new_event_loop().run_until_complete(body())
