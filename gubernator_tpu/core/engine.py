@@ -124,6 +124,7 @@ class RateLimitEngine:
         global_capacity: int = 4096,
         global_batch_per_shard: int = 256,
         max_global_updates: int = 256,
+        use_native: str = "auto",
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.num_shards = int(np.prod(list(self.mesh.shape.values())))
@@ -169,6 +170,18 @@ class RateLimitEngine:
         self.windows_processed = 0
         self.decisions_processed = 0
 
+        # Native C++ window router (gubernator_tpu/native): batch key hashing,
+        # shard routing, slot lookup + LRU in one C call per window, replacing
+        # the per-key Python dict path.  The two backends are exclusive —
+        # regular-key routing state lives in exactly one of them.
+        self.native = None
+        if use_native in ("auto", True, "on"):
+            from gubernator_tpu import native as native_mod
+            if native_mod.available():
+                self.native = native_mod.NativeRouter(S, C)
+            elif use_native != "auto":
+                raise RuntimeError("native router requested but unavailable")
+
     # ------------------------------------------------------------------ device
 
     def _build_step(self):
@@ -201,6 +214,8 @@ class RateLimitEngine:
         global_batch_per_shard, distinct GLOBAL keys + upserts <=
         max_global_updates.
         """
+        if self.native is not None:
+            return self._process_native(requests, now, accumulate, upserts)
         if now is None:
             now = millisecond_now()
         S = self.num_shards
@@ -308,6 +323,178 @@ class RateLimitEngine:
             )
         return responses
 
+    def _process_native(
+        self,
+        requests: Sequence[RateLimitReq],
+        now: Optional[int] = None,
+        accumulate: Optional[Sequence[bool]] = None,
+        upserts: Optional[Sequence] = None,
+    ) -> List[RateLimitResp]:
+        """Window processing with the C++ router resolving regular keys.
+
+        One `router_pack` call hashes, routes, and slot-allocates a whole
+        window directly into the staging buffers; lane overflow returns a
+        partial pack and the loop ships what fit (built-in chunking).  GLOBAL
+        keys and upserts are rare control-plane traffic and keep the Python
+        gtable path, packed into the same device dispatch.
+        """
+        if now is None:
+            now = millisecond_now()
+        S = self.num_shards
+        B = self.batch_per_shard
+        buf = self._buf
+        responses: List[Optional[RateLimitResp]] = [None] * len(requests)
+
+        # split into regular (columnar) and global (listed) requests
+        reg_idx: List[int] = []
+        keys_b: List[bytes] = []
+        rhits: List[int] = []
+        rlim: List[int] = []
+        rdur: List[int] = []
+        ralgo: List[int] = []
+        glob: List[tuple] = []
+        for i, r in enumerate(requests):
+            if r.behavior == Behavior.GLOBAL:
+                glob.append((i, r, accumulate is None or accumulate[i]))
+            else:
+                reg_idx.append(i)
+                keys_b.append(r.hash_key().encode("utf-8"))
+                rhits.append(r.hits)
+                rlim.append(r.limit)
+                rdur.append(r.duration)
+                ralgo.append(r.algorithm)
+        nreg = len(reg_idx)
+        if nreg:
+            key_bytes = np.frombuffer(b"".join(keys_b), dtype=np.uint8)
+            key_ends = np.cumsum([len(k) for k in keys_b]).astype(np.int64)
+            c_hits = np.asarray(rhits, dtype=np.int64)
+            c_lim = np.asarray(rlim, dtype=np.int64)
+            c_dur = np.asarray(rdur, dtype=np.int64)
+            c_algo = np.asarray(ralgo, dtype=np.int32)
+            out_shard = np.zeros(nreg, np.int32)
+            out_lane = np.zeros(nreg, np.int32)
+        shard_fill = np.zeros(S, np.int32)
+
+        pending_upserts = list(upserts) if upserts else []
+        pos = 0
+        gpos = 0
+        while pos < nreg or gpos < len(glob) or pending_upserts:
+            buf.reset(self.global_capacity)
+            shard_fill[:] = 0
+
+            ups_chunk = pending_upserts[: self.max_global_updates]
+            pending_upserts = pending_upserts[self.max_global_updates:]
+            for i, u in enumerate(ups_chunk):
+                slot, _ = self.gtable.lookup(u.key, now, u.duration)
+                st = u.status
+                buf.pslot[i] = slot
+                buf.plimit[i] = st.limit
+                buf.pduration[i] = u.duration
+                buf.premaining[i] = st.remaining
+                is_token = u.algorithm == Algorithm.TOKEN_BUCKET
+                buf.ptstamp[i] = st.reset_time if is_token else now
+                buf.pexpire[i] = st.reset_time if is_token else now + u.duration
+                buf.palgo[i] = u.algorithm
+
+            packed = 0
+            if pos < nreg:
+                base = 0 if pos == 0 else int(key_ends[pos - 1])
+                packed = self.native.pack(
+                    key_bytes[base:], key_ends[pos:] - base,
+                    c_hits[pos:], c_lim[pos:], c_dur[pos:], c_algo[pos:],
+                    now, B,
+                    buf.slot, buf.hits, buf.limit, buf.duration, buf.algo,
+                    buf.is_init.view(np.uint8),
+                    out_shard[pos:], out_lane[pos:], shard_fill,
+                )
+
+            # global lanes (python table), bounded by caps
+            glanes: List[tuple] = []
+            glob_fill = [0] * S
+            gcfg_upd = {}
+            greset: List[int] = []
+            while gpos + len(glanes) < len(glob):
+                i, r, contribute = glob[gpos + len(glanes)]
+                key = r.hash_key()
+                s = shard_of(key, S)
+                if glob_fill[s] + 1 > self.global_batch_per_shard:
+                    break
+                if len(gcfg_upd) + 1 > self.max_global_updates:
+                    break
+                slot, is_init = self.gtable.lookup(key, now, r.duration)
+                if contribute:
+                    gcfg_upd[slot] = (r.limit, r.duration, r.algorithm)
+                    if is_init:
+                        greset.append(slot)
+                lane = glob_fill[s]
+                glob_fill[s] += 1
+                buf.gslot[s, lane] = slot
+                buf.ghits[s, lane] = r.hits
+                buf.ghits_acc[s, lane] = r.hits if contribute else 0
+                buf.glimit[s, lane] = r.limit
+                buf.gduration[s, lane] = r.duration
+                buf.galgo[s, lane] = r.algorithm
+                buf.gis_init[s, lane] = is_init
+                glanes.append((i, s, lane))
+            for j, (slot, cfg) in enumerate(gcfg_upd.items()):
+                buf.uslot[j] = slot
+                buf.ulimit[j], buf.uduration[j], buf.ualgo[j] = cfg
+            for j, slot in enumerate(greset):
+                buf.rslot[j] = slot
+
+            if packed == 0 and not glanes and not ups_chunk:
+                raise RuntimeError("window packing made no progress")
+
+            out, gout = self._dispatch(now)
+            if packed:
+                # vectorized demux: one fancy-indexed gather per field, then
+                # plain-python scalars (per-item numpy indexing is ~10x slower)
+                sh = out_shard[pos:pos + packed]
+                ln = out_lane[pos:pos + packed]
+                sts = out.status[sh, ln].tolist()
+                lims = out.limit[sh, ln].tolist()
+                rems = out.remaining[sh, ln].tolist()
+                rsts = out.reset_time[sh, ln].tolist()
+                for j, i in enumerate(reg_idx[pos:pos + packed]):
+                    responses[i] = RateLimitResp(
+                        status=sts[j], limit=lims[j],
+                        remaining=rems[j], reset_time=rsts[j],
+                    )
+            for i, s, lane in glanes:
+                responses[i] = RateLimitResp(
+                    status=int(gout.status[s, lane]),
+                    limit=int(gout.limit[s, lane]),
+                    remaining=int(gout.remaining[s, lane]),
+                    reset_time=int(gout.reset_time[s, lane]),
+                )
+            pos += packed
+            gpos += len(glanes)
+            self.windows_processed += 1
+            self.decisions_processed += packed + len(glanes)
+
+        return responses  # type: ignore[return-value]
+
+    def _dispatch(self, now: int):
+        """Run the staged buffers through the device step; returns host copies
+        of the (regular, global) outputs."""
+        buf = self._buf
+        batch = WindowBatch(
+            slot=buf.slot, hits=buf.hits, limit=buf.limit,
+            duration=buf.duration, algo=buf.algo, is_init=buf.is_init,
+        )
+        gbatch = WindowBatch(
+            slot=buf.gslot, hits=buf.ghits, limit=buf.glimit,
+            duration=buf.gduration, algo=buf.galgo, is_init=buf.gis_init,
+        )
+        upd = (buf.uslot, buf.ulimit, buf.uduration, buf.ualgo, buf.rslot)
+        ups = (buf.pslot, buf.plimit, buf.pduration, buf.premaining,
+               buf.ptstamp, buf.pexpire, buf.palgo)
+        self.state, out, self.gstate, self.gcfg, gout = self._step_fn(
+            self.state, self.gstate, self.gcfg, batch, gbatch, buf.ghits_acc,
+            upd, ups, jnp.int64(now),
+        )
+        return jax.device_get(out), jax.device_get(gout)
+
     def process(
         self,
         requests: Sequence[RateLimitReq],
@@ -315,6 +502,8 @@ class RateLimitEngine:
         accumulate: Optional[Sequence[bool]] = None,
     ) -> List[RateLimitResp]:
         """step() with automatic chunking when a window overflows the caps."""
+        if self.native is not None:
+            return self._process_native(requests, now, accumulate)
         S = self.num_shards
         out: List[RateLimitResp] = []
         chunk: List[RateLimitReq] = []
@@ -358,15 +547,21 @@ class RateLimitEngine:
 
     @property
     def cache_size(self) -> int:
-        return sum(len(t) for t in self.tables) + len(self.gtable)
+        reg = (self.native.size if self.native is not None
+               else sum(len(t) for t in self.tables))
+        return reg + len(self.gtable)
 
     @property
     def cache_hits(self) -> int:
-        return sum(t.hits for t in self.tables) + self.gtable.hits
+        reg = (self.native.hits if self.native is not None
+               else sum(t.hits for t in self.tables))
+        return reg + self.gtable.hits
 
     @property
     def cache_misses(self) -> int:
-        return sum(t.misses for t in self.tables) + self.gtable.misses
+        reg = (self.native.misses if self.native is not None
+               else sum(t.misses for t in self.tables))
+        return reg + self.gtable.misses
 
 
 @lru_cache(maxsize=None)

@@ -1,0 +1,134 @@
+"""Native host runtime: ctypes bindings for the C++ window router.
+
+Compiles host_router.cc on first use (g++ -O2 -shared) and caches the .so
+next to the source; falls back cleanly if no toolchain is present — callers
+check `available()` and use the Python router otherwise.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("gubernator.native")
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "host_router.cc")
+_SO = os.path.join(_HERE, "libhost_router.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_lib_failed = False
+
+
+def _build() -> None:
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _SO, _SRC]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except Exception as e:
+            log.warning("native router unavailable (%s); using Python path", e)
+            _lib_failed = True
+            return None
+
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.router_new.restype = ctypes.c_void_p
+        lib.router_new.argtypes = [ctypes.c_int32, ctypes.c_int32]
+        lib.router_free.argtypes = [ctypes.c_void_p]
+        lib.router_pack.restype = ctypes.c_int64
+        lib.router_pack.argtypes = [
+            ctypes.c_void_p, u8p, i64p, ctypes.c_int64,
+            i64p, i64p, i64p, i32p, ctypes.c_int64, ctypes.c_int32,
+            i32p, i64p, i64p, i64p, i32p, u8p, i32p, i32p, i32p,
+        ]
+        for fn in ("router_size", "router_hits", "router_misses"):
+            getattr(lib, fn).restype = ctypes.c_int64
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class NativeRouter:
+    """Batch key→(shard, slot) resolution + window packing in one C call."""
+
+    def __init__(self, num_shards: int, capacity_per_shard: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native router library unavailable")
+        self._lib = lib
+        self._handle = lib.router_new(num_shards, capacity_per_shard)
+        self.num_shards = num_shards
+        self.capacity_per_shard = capacity_per_shard
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.router_free(handle)
+            self._handle = None
+
+    def pack(
+        self,
+        key_bytes: np.ndarray,   # uint8 concatenated keys
+        key_ends: np.ndarray,    # int64 exclusive end offsets
+        hits: np.ndarray, limits: np.ndarray, durations: np.ndarray,
+        algos: np.ndarray, now: int, lanes: int,
+        out_slot: np.ndarray, out_hits: np.ndarray, out_limit: np.ndarray,
+        out_duration: np.ndarray, out_algo: np.ndarray,
+        out_is_init: np.ndarray,
+        out_shard: np.ndarray, out_lane: np.ndarray,
+        shard_fill: np.ndarray,
+    ) -> int:
+        """Returns how many of the n requests were packed (< n on lane
+        overflow; ship the window and repack the remainder)."""
+        n = len(key_ends)
+        return self._lib.router_pack(
+            self._handle,
+            _ptr(key_bytes, ctypes.c_uint8), _ptr(key_ends, ctypes.c_int64),
+            n,
+            _ptr(hits, ctypes.c_int64), _ptr(limits, ctypes.c_int64),
+            _ptr(durations, ctypes.c_int64), _ptr(algos, ctypes.c_int32),
+            now, lanes,
+            _ptr(out_slot, ctypes.c_int32), _ptr(out_hits, ctypes.c_int64),
+            _ptr(out_limit, ctypes.c_int64), _ptr(out_duration, ctypes.c_int64),
+            _ptr(out_algo, ctypes.c_int32), _ptr(out_is_init, ctypes.c_uint8),
+            _ptr(out_shard, ctypes.c_int32), _ptr(out_lane, ctypes.c_int32),
+            _ptr(shard_fill, ctypes.c_int32),
+        )
+
+    @property
+    def size(self) -> int:
+        return self._lib.router_size(self._handle)
+
+    @property
+    def hits(self) -> int:
+        return self._lib.router_hits(self._handle)
+
+    @property
+    def misses(self) -> int:
+        return self._lib.router_misses(self._handle)

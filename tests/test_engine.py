@@ -25,8 +25,8 @@ from .pyref import PyRefCache
 T0 = 1_700_000_000_000
 
 
-@pytest.fixture(scope="module")
-def engine():
+@pytest.fixture(scope="module", params=["python", "native"])
+def engine(request):
     assert len(jax.devices()) == 8
     return RateLimitEngine(
         capacity_per_shard=512,
@@ -34,6 +34,7 @@ def engine():
         global_capacity=128,
         global_batch_per_shard=32,
         max_global_updates=32,
+        use_native=(False if request.param == "python" else "auto"),
     )
 
 
