@@ -91,7 +91,7 @@ async def start_with(
 
         # compile the shared device step before serving — otherwise the first
         # real window pays a multi-second jit while peer batch RPCs time out
-        cluster.nodes[0].instance.engine.step([])
+        cluster.nodes[0].instance.engine.warmup()
 
         peers = [PeerInfo(address=a) for a in cluster.addresses]
         for node in cluster.nodes:

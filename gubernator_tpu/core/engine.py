@@ -474,6 +474,14 @@ class RateLimitEngine:
 
         return responses  # type: ignore[return-value]
 
+    def warmup(self) -> None:
+        """Compile and execute one empty window so serving never pays the jit.
+
+        (An empty `process()` call is a no-op on the native path, so callers
+        that need the compile — cluster boot, daemon start — use this.)"""
+        self._buf.reset(self.global_capacity)
+        self._dispatch(millisecond_now())
+
     def _dispatch(self, now: int):
         """Run the staged buffers through the device step; returns host copies
         of the (regular, global) outputs."""
@@ -564,6 +572,13 @@ class RateLimitEngine:
         return reg + self.gtable.misses
 
 
+def _use_pallas() -> bool:
+    """Opt-in Pallas lowering for the GLOBAL apply pass (GUBER_PALLAS=1).
+    Read at trace time — i.e. once per mesh, when _compiled_step builds."""
+    import os
+    return os.environ.get("GUBER_PALLAS") == "1"
+
+
 @lru_cache(maxsize=None)
 def _compiled_step(mesh: Mesh):
     def shard_fn(state, gstate, gcfg, batch, gbatch, gacc, upd, ups, now):
@@ -615,7 +630,13 @@ def _compiled_step(mesh: Mesh):
             # The whole GLOBAL reconciliation — the reference's async hit send
             # plus owner broadcast (global.go:72-232) — is this one collective.
             summed = lax.psum(delta, SHARD_AXIS)
-            new_g = kernel.global_apply(gstate, gcfg, summed, now)
+            if _use_pallas():
+                from gubernator_tpu.ops.pallas_kernel import global_apply_pallas
+                new_g = global_apply_pallas(
+                    gstate, gcfg, summed, now,
+                    interpret=jax.default_backend() == "cpu")
+            else:
+                new_g = kernel.global_apply(gstate, gcfg, summed, now)
 
             expand = lambda a: a[None]
             return (

@@ -58,7 +58,7 @@ class Daemon:
             advertise_address=c.advertise_address,
         ))
         # compile the device step before accepting traffic
-        self.instance.engine.step([])
+        self.instance.engine.warmup()
 
         self.grpc = GrpcServer(self.instance, c.grpc_listen_address)
         await self.grpc.start()

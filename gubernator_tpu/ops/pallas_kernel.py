@@ -1,0 +1,93 @@
+"""Pallas TPU kernel for the GLOBAL aggregate-apply step.
+
+`global_apply` (ops/kernel.py) is a pure elementwise pass over the whole
+replicated GLOBAL arena — six state arrays + config + the psum'd hit totals
+— executed every window.  This module lowers it through Pallas so the pass
+runs as one VMEM-resident kernel (grid-blocked over the arena) instead of an
+XLA fusion chain, and serves as the template for Pallas-lowering the
+per-shard window kernel.
+
+The kernel body *reuses* `kernel.transition` — the exact branch ladders that
+mirror reference algorithms.go:24-186 — applied to loaded blocks, so Pallas
+and XLA paths cannot drift semantically.
+
+State is int64 (ms-epoch timestamps + proto-contract counters).  Mosaic's
+int64 support on real TPU is not yet validated in this environment (the
+device tunnel was down when this was written), so the engine keeps the XLA
+path by default; enable with GUBER_PALLAS=1 or interpret=True (CPU tests run
+the kernel in interpret mode and pin it against the XLA implementation).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from gubernator_tpu.ops import kernel
+from gubernator_tpu.ops.kernel import BucketState, GlobalConfig, _Reg
+
+# lanes per grid step; arenas are sized in powers of two >= 1024
+BLOCK = 1024
+
+
+def _apply_kernel(now_ref, limit_ref, dur_ref, rem_ref, ts_ref, exp_ref,
+                  algo_ref, cl_ref, cd_ref, ca_ref, sum_ref,
+                  o_limit, o_dur, o_rem, o_ts, o_exp, o_algo):
+    reg = _Reg(
+        limit=limit_ref[:],
+        duration=dur_ref[:],
+        remaining=rem_ref[:],
+        tstamp=ts_ref[:],
+        expire=exp_ref[:],
+        algo=algo_ref[:],
+    )
+    now = now_ref[0]
+    summed = sum_ref[:]
+    cfg_algo = ca_ref[:]
+    fresh = (reg.expire < now) | (cfg_algo != reg.algo)
+    new_reg, _ = kernel.transition(
+        reg, summed, cl_ref[:], cd_ref[:], cfg_algo, now, fresh)
+    touched = summed != 0
+    o_limit[:] = jnp.where(touched, new_reg.limit, reg.limit)
+    o_dur[:] = jnp.where(touched, new_reg.duration, reg.duration)
+    o_rem[:] = jnp.where(touched, new_reg.remaining, reg.remaining)
+    o_ts[:] = jnp.where(touched, new_reg.tstamp, reg.tstamp)
+    o_exp[:] = jnp.where(touched, new_reg.expire, reg.expire)
+    o_algo[:] = jnp.where(touched, new_reg.algo, reg.algo)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def global_apply_pallas(state: BucketState, cfg: GlobalConfig,
+                        summed_hits: jax.Array, now, *,
+                        interpret: bool = False) -> BucketState:
+    """Drop-in replacement for kernel.global_apply via pallas_call."""
+    G = state.limit.shape[0]
+    block = min(BLOCK, G)
+    assert G % block == 0, "global arena capacity must be a multiple of the block"
+    grid = (G // block,)
+    spec = pl.BlockSpec((block,), lambda i: (i,))
+    now_arr = jnp.asarray(now, jnp.int64).reshape((1,))
+
+    # the global arena is replicated across the mesh, so under shard_map the
+    # outputs vary over no axes (vma=()); outside shard_map the annotation is
+    # inert
+    sds = lambda dt: jax.ShapeDtypeStruct((G,), dt, vma=frozenset())
+    out_shapes = [sds(jnp.int64)] * 5 + [sds(jnp.int32)]
+    outs = pl.pallas_call(
+        _apply_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1,), lambda i: (0,)),  # now (broadcast)
+            spec, spec, spec, spec, spec, spec,  # state
+            spec, spec, spec,                    # cfg
+            spec,                                # summed
+        ],
+        out_specs=[spec] * 6,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(now_arr, state.limit, state.duration, state.remaining, state.tstamp,
+      state.expire, state.algo, cfg.limit, cfg.duration, cfg.algo, summed_hits)
+    return BucketState(*outs)
