@@ -3,12 +3,16 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Measures the production device step (the same jitted shard_map computation
-RateLimitEngine dispatches every batching window) in steady state on a
-1-chip mesh: mixed TOKEN+LEAKY buckets over a 1M-slot arena with Zipf(1.1)
-hot-key skew — the shape of BASELINE.md eval configs (2)/(3).  Windows are
-pre-packed on device so the number reflects the decision engine itself, not
-Python host packing (reported separately on stderr for context).
+Measures the production steady-state serving path on a 1-chip mesh: mixed
+TOKEN+LEAKY buckets over a 1M-slot arena with Zipf(1.1) hot-key skew — the
+shape of BASELINE.md eval configs (2)/(3).  At high load the engine ships K
+batching windows per device dispatch (`RateLimitEngine.step_windows`, a
+lax.scan over full serving windows — semantics pinned to sequential steps by
+tests/test_multi_window.py); the headline number is that path with every
+dispatch synced before the next, i.e. it includes the host→device round trip
+every K windows, exactly as serving pays it.  Windows are pre-packed on
+device so the number reflects the decision engine, not Python host packing
+(reported separately on stderr for context).
 
 vs_baseline compares against the reference's published single-node
 throughput: >2,000 client requests/sec in production (README.md:94-99 — its
@@ -27,7 +31,7 @@ def main():
     import jax.numpy as jnp
 
     import gubernator_tpu  # noqa: F401
-    from gubernator_tpu.core.engine import RateLimitEngine, _compiled_step
+    from gubernator_tpu.core.engine import RateLimitEngine
     from gubernator_tpu.ops import kernel
     from gubernator_tpu.parallel.mesh import make_mesh
 
@@ -35,10 +39,10 @@ def main():
     print(f"# backend: {dev.platform} ({dev.device_kind})", file=sys.stderr)
 
     CAPACITY = 1 << 20  # 1M slots resident in HBM
-    LANES = 8192  # decisions per window
-    N_WINDOWS = 16  # distinct pre-packed windows, cycled
-    WARMUP = 5
-    ITERS = 200
+    LANES = 32768  # decisions per window
+    K = 8  # windows per device dispatch at saturation
+    N_STACKS = 4  # distinct pre-packed dispatch stacks, cycled
+    ITERS = 100  # timed dispatches (ITERS * K * LANES decisions)
 
     mesh = make_mesh(jax.devices()[:1])
     eng = RateLimitEngine(
@@ -49,88 +53,100 @@ def main():
         global_batch_per_shard=128,
         max_global_updates=128,
     )
-    step = eng._step_fn
 
     # Zipf(1.1) slot distribution over the arena (hot-key skew), mixed algos.
     rng = np.random.default_rng(7)
-    zipf = rng.zipf(1.1, size=(N_WINDOWS, LANES))
-    slots = ((zipf - 1) % CAPACITY).astype(np.int32)
 
-    def pack(i):
-        s = slots[i]
+    def pack_window():
+        zipf = rng.zipf(1.1, size=LANES)
+        s = ((zipf - 1) % CAPACITY).astype(np.int32)
         return kernel.WindowBatch(
-            slot=jnp.asarray(s[None, :]),
-            hits=jnp.ones((1, LANES), jnp.int64),
-            limit=jnp.full((1, LANES), 1_000_000, jnp.int64),
-            duration=jnp.full((1, LANES), 60_000, jnp.int64),
-            algo=jnp.asarray((s % 2).astype(np.int32)[None, :]),
-            is_init=jnp.zeros((1, LANES), bool),
+            slot=s[None, :],
+            hits=np.ones((1, LANES), np.int64),
+            limit=np.full((1, LANES), 1_000_000, np.int64),
+            duration=np.full((1, LANES), 60_000, np.int64),
+            algo=(s % 2).astype(np.int32)[None, :],
+            is_init=np.zeros((1, LANES), bool),
         )
 
-    batches = [jax.device_put(pack(i)) for i in range(N_WINDOWS)]
-    empty_g = jax.device_put(kernel.WindowBatch(*[
-        a[None, :] for a in kernel.WindowBatch.pad(eng.global_batch_per_shard)
-    ]))
-    gacc = jax.device_put(jnp.zeros((1, eng.global_batch_per_shard), jnp.int64))
-    G = eng.global_capacity
-    Kg = eng.max_global_updates
-    upd = jax.device_put((
-        jnp.full((Kg,), G, jnp.int32), jnp.zeros((Kg,), jnp.int64),
-        jnp.zeros((Kg,), jnp.int64), jnp.zeros((Kg,), jnp.int32),
-        jnp.full((Kg,), G, jnp.int32),
-    ))
-    ups = jax.device_put((
-        jnp.full((Kg,), G, jnp.int32), jnp.zeros((Kg,), jnp.int64),
-        jnp.zeros((Kg,), jnp.int64), jnp.zeros((Kg,), jnp.int64),
-        jnp.zeros((Kg,), jnp.int64), jnp.zeros((Kg,), jnp.int64),
-        jnp.zeros((Kg,), jnp.int32),
-    ))
+    def stack(ws):
+        return kernel.WindowBatch(*[
+            np.stack([getattr(w, f) for w in ws]) for f in ws[0]._fields])
 
-    state, gstate, gcfg = eng.state, eng.gstate, eng.gcfg
+    stacks = [jax.device_put(stack([pack_window() for _ in range(K)]))
+              for _ in range(N_STACKS)]
+    gbatch, gacc, upd, ups = eng.empty_control()
+    gstack = jax.device_put(kernel.WindowBatch(*[
+        np.stack([getattr(gbatch, f)] * K) for f in gbatch._fields]))
+    gaccs = jax.device_put(np.stack([gacc] * K))
+    upd = jax.device_put(upd)
+    ups = jax.device_put(ups)
+
     now = 1_700_000_000_000
 
-    def run_one(i, state, gstate, gcfg, t):
-        return step(state, gstate, gcfg, batches[i % N_WINDOWS], empty_g,
-                    gacc, upd, ups, jnp.int64(t))
+    def dispatch(i, t):
+        nows = jnp.arange(K, dtype=jnp.int64) + t
+        return eng.step_windows(stacks[i % N_STACKS], gstack, gaccs,
+                                upd, ups, nows)
 
     # warmup (compile + arena fill)
-    for i in range(WARMUP):
-        state, out, gstate, gcfg, _ = run_one(i, state, gstate, gcfg, now + i)
+    for i in range(3):
+        out, _ = dispatch(i, now + i * K)
     jax.block_until_ready(out)
 
     lat = []
     t0 = time.perf_counter()
     for i in range(ITERS):
         w0 = time.perf_counter()
-        state, out, gstate, gcfg, _ = run_one(i, state, gstate, gcfg,
-                                              now + WARMUP + i)
-        # per-window latency includes the device sync a real serving window
-        # pays before demuxing responses
+        out, _ = dispatch(i, now + (3 + i) * K)
+        # sync before the next dispatch — serving demuxes responses here
         jax.block_until_ready(out)
         lat.append(time.perf_counter() - w0)
     total = time.perf_counter() - t0
 
-    decisions = ITERS * LANES
+    decisions = ITERS * K * LANES
     per_sec = decisions / total
     lat_ms = np.array(lat) * 1000.0
     print(
-        f"# windows: {ITERS} x {LANES} lanes; window p50={np.percentile(lat_ms, 50):.3f}ms "
+        f"# dispatches: {ITERS} x {K} windows x {LANES} lanes; "
+        f"dispatch p50={np.percentile(lat_ms, 50):.3f}ms "
         f"p99={np.percentile(lat_ms, 99):.3f}ms; capacity={CAPACITY}",
         file=sys.stderr,
     )
 
-    # hand the final (donated-through) buffers back to the engine
-    eng.state, eng.gstate, eng.gcfg = state, gstate, gcfg
+    # context: single-window dispatch latency (low-load serving path)
+    sb = jax.device_put(kernel.WindowBatch(*[a[:1] for a in pack_window()]))
+    sg = jax.device_put(gbatch)
+    sa = jax.device_put(gacc)
+    for i in range(3):
+        eng.state, sout, eng.gstate, eng.gcfg, _ = eng._step_fn(
+            eng.state, eng.gstate, eng.gcfg, sb, sg, sa, upd, ups,
+            jnp.int64(now + 10_000 + i))
+    jax.block_until_ready(sout)
+    slat = []
+    for i in range(50):
+        w0 = time.perf_counter()
+        eng.state, sout, eng.gstate, eng.gcfg, _ = eng._step_fn(
+            eng.state, eng.gstate, eng.gcfg, sb, sg, sa, upd, ups,
+            jnp.int64(now + 20_000 + i))
+        jax.block_until_ready(sout)
+        slat.append(time.perf_counter() - w0)
+    slat_ms = np.array(slat) * 1000.0
+    print(
+        f"# single window ({LANES} lanes): p50={np.percentile(slat_ms, 50):.3f}ms "
+        f"p99={np.percentile(slat_ms, 99):.3f}ms",
+        file=sys.stderr,
+    )
 
     # context: host-path throughput through the full engine (Python packing)
     from gubernator_tpu.api.types import RateLimitReq
     reqs = [RateLimitReq(name="b", unique_key=f"k{i}", hits=1, limit=100,
                          duration=60_000) for i in range(1000)]
-    eng.process(reqs, now=now)  # warm slot table
+    eng.process(reqs, now=now + 40_000)  # warm slot table
     h0 = time.perf_counter()
     H = 5
     for i in range(H):
-        eng.process(reqs, now=now + i)
+        eng.process(reqs, now=now + 40_001 + i)
     host_per_sec = H * len(reqs) / (time.perf_counter() - h0)
     print(f"# host-packed path: {host_per_sec:,.0f} decisions/sec", file=sys.stderr)
 

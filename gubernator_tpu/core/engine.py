@@ -167,6 +167,7 @@ class RateLimitEngine:
         self.gtable = SlotTable(G)
         self._buf = _PackedWindow(S, batch_per_shard, global_batch_per_shard, max_global_updates)
         self._step_fn = self._build_step()
+        self._multi_fn = _compiled_multi_step(self.mesh)
         self.windows_processed = 0
         self.decisions_processed = 0
 
@@ -474,6 +475,59 @@ class RateLimitEngine:
 
         return responses  # type: ignore[return-value]
 
+    def step_windows(
+        self,
+        batches: WindowBatch,
+        gbatches: WindowBatch,
+        gaccs,
+        upd,
+        ups,
+        nows,
+    ) -> tuple[WindowOutput, WindowOutput]:
+        """Apply K stacked windows in one device dispatch (see
+        _compiled_multi_step).  All arguments carry a leading K dimension
+        except upd/ups (control plane, applied ONCE, before window 0) — so
+        this equals K sequential step() calls whose first window carries all
+        the control-plane writes; callers with upserts destined for a later
+        window must split the dispatch at that window.
+
+        Inputs may be numpy or device arrays; outputs are device arrays
+        ([K, S, B*] per field) left un-fetched so callers can overlap demux
+        with the next dispatch.
+        """
+        self.state, out, self.gstate, self.gcfg, gout = self._multi_fn(
+            self.state, self.gstate, self.gcfg, batches, gbatches, gaccs,
+            upd, ups, nows,
+        )
+        k = int(batches.slot.shape[0])
+        self.windows_processed += k
+        lanes = int(np.prod(batches.slot.shape[1:]))
+        self.decisions_processed += k * lanes
+        return out, gout
+
+    def empty_control(self):
+        """(gbatch, gacc, upd, ups) padding values for windows that carry no
+        GLOBAL traffic — lanes point one past the arena and are dropped."""
+        S, Bg, G, Kg = (self.num_shards, self.global_batch_per_shard,
+                        self.global_capacity, self.max_global_updates)
+        gbatch = WindowBatch(
+            slot=np.full((S, Bg), kernel.PAD_SLOT, np.int32),
+            hits=np.zeros((S, Bg), np.int64),
+            limit=np.zeros((S, Bg), np.int64),
+            duration=np.zeros((S, Bg), np.int64),
+            algo=np.zeros((S, Bg), np.int32),
+            is_init=np.zeros((S, Bg), bool),
+        )
+        gacc = np.zeros((S, Bg), np.int64)
+        upd = (np.full((Kg,), G, np.int32), np.zeros((Kg,), np.int64),
+               np.zeros((Kg,), np.int64), np.zeros((Kg,), np.int32),
+               np.full((Kg,), G, np.int32))
+        ups = (np.full((Kg,), G, np.int32), np.zeros((Kg,), np.int64),
+               np.zeros((Kg,), np.int64), np.zeros((Kg,), np.int64),
+               np.zeros((Kg,), np.int64), np.zeros((Kg,), np.int64),
+               np.zeros((Kg,), np.int32))
+        return gbatch, gacc, upd, ups
+
     def warmup(self) -> None:
         """Compile and execute one empty window so serving never pays the jit.
 
@@ -579,6 +633,65 @@ def _use_pallas() -> bool:
     return os.environ.get("GUBER_PALLAS") == "1"
 
 
+def _apply_control(gstate: BucketState, gcfg: GlobalConfig, upd, ups):
+    """Apply host control-plane writes to the GLOBAL arena (once per dispatch).
+
+    Upserts land first: authoritative replica state pushed by a cross-host
+    owner (the reference's UpdatePeerGlobals -> Cache.Add path,
+    gubernator.go:199-207).  Then host-issued slot (re)configurations: the
+    config write refreshes limit/duration/algorithm from the latest request
+    each window (the reference owner applies the config carried on each
+    aggregated request, global.go:115-153); the state reset (expire=0 reads
+    as never-initialized) happens only for lanes the host just (re)allocated.
+    """
+    (pslot, plimit, pduration, premaining, ptstamp, pexpire, palgo) = ups
+    gstate = BucketState(
+        limit=gstate.limit.at[pslot].set(plimit, mode="drop"),
+        duration=gstate.duration.at[pslot].set(pduration, mode="drop"),
+        remaining=gstate.remaining.at[pslot].set(premaining, mode="drop"),
+        tstamp=gstate.tstamp.at[pslot].set(ptstamp, mode="drop"),
+        expire=gstate.expire.at[pslot].set(pexpire, mode="drop"),
+        algo=gstate.algo.at[pslot].set(palgo, mode="drop"),
+    )
+    gcfg = GlobalConfig(
+        limit=gcfg.limit.at[pslot].set(plimit, mode="drop"),
+        duration=gcfg.duration.at[pslot].set(pduration, mode="drop"),
+        algo=gcfg.algo.at[pslot].set(palgo, mode="drop"),
+    )
+    uslot, ulimit, uduration, ualgo, rslot = upd
+    gcfg = GlobalConfig(
+        limit=gcfg.limit.at[uslot].set(ulimit, mode="drop"),
+        duration=gcfg.duration.at[uslot].set(uduration, mode="drop"),
+        algo=gcfg.algo.at[uslot].set(ualgo, mode="drop"),
+    )
+    gstate = gstate._replace(
+        expire=gstate.expire.at[rslot].set(jnp.int64(0), mode="drop")
+    )
+    return gstate, gcfg
+
+
+def _global_window(gstate: BucketState, gcfg: GlobalConfig, gb: WindowBatch,
+                   gacc_row, now):
+    """One window of GLOBAL traffic: replica reads + the reconciliation psum.
+
+    The whole GLOBAL dance — the reference's async hit send plus owner
+    broadcast (global.go:72-232) — is this one collective.
+    """
+    gout = kernel.global_read(gstate, gb, now)
+    delta = kernel.global_accumulate(
+        jnp.zeros_like(gstate.remaining), gb._replace(hits=gacc_row)
+    )
+    summed = lax.psum(delta, SHARD_AXIS)
+    if _use_pallas():
+        from gubernator_tpu.ops.pallas_kernel import global_apply_pallas
+        new_g = global_apply_pallas(
+            gstate, gcfg, summed, now,
+            interpret=jax.default_backend() == "cpu")
+    else:
+        new_g = kernel.global_apply(gstate, gcfg, summed, now)
+    return new_g, gout
+
+
 @lru_cache(maxsize=None)
 def _compiled_step(mesh: Mesh):
     def shard_fn(state, gstate, gcfg, batch, gbatch, gacc, upd, ups, now):
@@ -588,55 +701,9 @@ def _compiled_step(mesh: Mesh):
             bt = WindowBatch(*jax.tree.map(lambda a: a[0], batch))
             new_st, out = kernel.window_step(st, bt, now)
 
-            # Owner-broadcast upserts land first: authoritative replica state
-            # pushed by a cross-host owner (the reference's UpdatePeerGlobals
-            # -> Cache.Add path, gubernator.go:199-207).
-            (pslot, plimit, pduration, premaining, ptstamp, pexpire, palgo) = ups
-            gstate = BucketState(
-                limit=gstate.limit.at[pslot].set(plimit, mode="drop"),
-                duration=gstate.duration.at[pslot].set(pduration, mode="drop"),
-                remaining=gstate.remaining.at[pslot].set(premaining, mode="drop"),
-                tstamp=gstate.tstamp.at[pslot].set(ptstamp, mode="drop"),
-                expire=gstate.expire.at[pslot].set(pexpire, mode="drop"),
-                algo=gstate.algo.at[pslot].set(palgo, mode="drop"),
-            )
-            gcfg = GlobalConfig(
-                limit=gcfg.limit.at[pslot].set(plimit, mode="drop"),
-                duration=gcfg.duration.at[pslot].set(pduration, mode="drop"),
-                algo=gcfg.algo.at[pslot].set(palgo, mode="drop"),
-            )
-
-            # Apply host-issued GLOBAL slot (re)configurations.  The config
-            # write refreshes limit/duration/algorithm from the latest request
-            # each window (the reference owner applies the config carried on
-            # each aggregated request, global.go:115-153); the state reset
-            # (expire=0 reads as never-initialized) happens only for lanes the
-            # host just (re)allocated.
-            uslot, ulimit, uduration, ualgo, rslot = upd
-            gcfg = GlobalConfig(
-                limit=gcfg.limit.at[uslot].set(ulimit, mode="drop"),
-                duration=gcfg.duration.at[uslot].set(uduration, mode="drop"),
-                algo=gcfg.algo.at[uslot].set(ualgo, mode="drop"),
-            )
-            gstate = gstate._replace(
-                expire=gstate.expire.at[rslot].set(jnp.int64(0), mode="drop")
-            )
-
+            gstate, gcfg = _apply_control(gstate, gcfg, upd, ups)
             gb = WindowBatch(*jax.tree.map(lambda a: a[0], gbatch))
-            gout = kernel.global_read(gstate, gb, now)
-            delta = kernel.global_accumulate(
-                jnp.zeros_like(gstate.remaining), gb._replace(hits=gacc[0])
-            )
-            # The whole GLOBAL reconciliation — the reference's async hit send
-            # plus owner broadcast (global.go:72-232) — is this one collective.
-            summed = lax.psum(delta, SHARD_AXIS)
-            if _use_pallas():
-                from gubernator_tpu.ops.pallas_kernel import global_apply_pallas
-                new_g = global_apply_pallas(
-                    gstate, gcfg, summed, now,
-                    interpret=jax.default_backend() == "cpu")
-            else:
-                new_g = kernel.global_apply(gstate, gcfg, summed, now)
+            new_g, gout = _global_window(gstate, gcfg, gb, gacc[0], now)
 
             expand = lambda a: a[None]
             return (
@@ -669,6 +736,80 @@ def _compiled_step(mesh: Mesh):
             state_repl,
             GlobalConfig(*[P()] * 3),
             WindowOutput(*[P(SHARD_AXIS)] * 4),
+        ),
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1, 2))
+
+
+@lru_cache(maxsize=None)
+def _compiled_multi_step(mesh: Mesh):
+    """K batching windows applied in ONE device dispatch via lax.scan.
+
+    Each scanned iteration is a full serving window — its own timestamp, its
+    own in-window sequencing, its own GLOBAL psum — identical in semantics to
+    K sequential `_compiled_step` calls.  What it saves is K-1 host→device
+    dispatch round trips: on a tunneled/remote chip the round trip (~200µs)
+    dominates the ~25µs window compute, so scanning windows is the throughput
+    path when the host has a backlog (the reference analog: a peer draining
+    its queue ships batches back-to-back without waiting for each response,
+    peers.go:143-172).
+
+    Control-plane writes (GLOBAL upserts/config, host-rare) are applied once,
+    before the first window.  Stacked inputs carry a leading K dimension;
+    `nows` is i64[K], one timestamp per window.
+    """
+    def shard_fn(state, gstate, gcfg, batches, gbatches, gaccs, upd, ups, nows):
+        # Block shapes: state [1, C]; batches [K, 1, B]; gbatches [K, 1, Bg];
+        # gaccs [K, 1, Bg]; gstate/gcfg [G] replicated; nows [K].
+        st = BucketState(*jax.tree.map(lambda a: a[0], state))
+        gstate, gcfg = _apply_control(gstate, gcfg, upd, ups)
+
+        def body(carry, xs):
+            st, gst = carry
+            b, gb, gacc, now = xs
+            bt = WindowBatch(*jax.tree.map(lambda a: a[0], b))
+            st, out = kernel.window_step(st, bt, now)
+            gbt = WindowBatch(*jax.tree.map(lambda a: a[0], gb))
+            gst, gout = _global_window(gst, gcfg, gbt, gacc[0], now)
+            return (st, gst), (out, gout)
+
+        (st, gst), (outs, gouts) = lax.scan(
+            body, (st, gstate), (batches, gbatches, gaccs, nows)
+        )
+        expand = lambda a: a[None]
+        # outs: [K, B] per field -> [K, 1, B] so the shard axis is explicit
+        expand_mid = lambda a: a[:, None]
+        return (
+            BucketState(*jax.tree.map(expand, st)),
+            WindowOutput(*jax.tree.map(expand_mid, outs)),
+            gst,
+            gcfg,
+            WindowOutput(*jax.tree.map(expand_mid, gouts)),
+        )
+
+    state_sharded = BucketState(*[P(SHARD_AXIS)] * 6)
+    state_repl = BucketState(*[P()] * 6)
+    stackedP = P(None, SHARD_AXIS)
+    sharded = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            state_sharded,
+            state_repl,
+            GlobalConfig(*[P()] * 3),
+            WindowBatch(*[stackedP] * 6),
+            WindowBatch(*[stackedP] * 6),
+            stackedP,
+            (P(), P(), P(), P(), P()),
+            (P(),) * 7,
+            P(),
+        ),
+        out_specs=(
+            state_sharded,
+            WindowOutput(*[stackedP] * 4),
+            state_repl,
+            GlobalConfig(*[P()] * 3),
+            WindowOutput(*[stackedP] * 4),
         ),
     )
     return jax.jit(sharded, donate_argnums=(0, 1, 2))

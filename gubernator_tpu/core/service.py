@@ -65,6 +65,7 @@ class Instance:
             global_batch_per_shard=e.global_batch_per_shard,
             max_global_updates=e.max_global_updates,
         )
+        self.metrics.watch_engine(self.engine)
         self.batcher = WindowBatcher(self.engine, self.conf.behaviors, self.metrics)
         self.global_mgr = GlobalManager(
             self.conf.behaviors, self, self.metrics, log)

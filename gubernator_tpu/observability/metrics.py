@@ -31,6 +31,7 @@ class Metrics:
 
     def __init__(self, registry: Optional[CollectorRegistry] = None):
         self.registry = registry or CollectorRegistry()
+        self._scrape_hooks = []
         self.cache_size = Gauge(
             "cache_size",
             "Size of the cache which holds the rate limits.",
@@ -82,7 +83,32 @@ class Metrics:
             registry=self.registry,
         )
 
+    def add_scrape_hook(self, fn) -> None:
+        """Register a callable run before every expose() — the analog of the
+        reference's Collector.Collect pulling live stats at scrape time
+        (cache/lru.go:160-172, gubernator.go:313-322)."""
+        self._scrape_hooks.append(fn)
+
+    def watch_engine(self, engine) -> None:
+        """Export the engine's cache stats at scrape time: cache_size gauge
+        plus hit/miss counters advanced by delta since the last scrape."""
+        last = {"hit": 0, "miss": 0}
+
+        def refresh():
+            self.cache_size.set(engine.cache_size)
+            hits, misses = engine.cache_hits, engine.cache_misses
+            if hits > last["hit"]:
+                self.cache_access_count.labels(type="hit").inc(hits - last["hit"])
+                last["hit"] = hits
+            if misses > last["miss"]:
+                self.cache_access_count.labels(type="miss").inc(misses - last["miss"])
+                last["miss"] = misses
+
+        self.add_scrape_hook(refresh)
+
     def expose(self) -> bytes:
+        for fn in self._scrape_hooks:
+            fn()
         return generate_latest(self.registry)
 
     def observe_rpc(self, method: str, start: float, ok: bool) -> None:

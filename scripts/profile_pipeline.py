@@ -1,0 +1,136 @@
+"""Validate pipelined window throughput through the production step fn.
+
+Compares blocking-per-window (current bench) vs pipelined dispatch with a
+bounded in-flight depth, using the full _compiled_step shard_map executable.
+Also profiles the host-packed path to find its bottleneck.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import gubernator_tpu  # noqa: F401
+    from gubernator_tpu.core.engine import RateLimitEngine
+    from gubernator_tpu.ops import kernel
+    from gubernator_tpu.parallel.mesh import make_mesh
+
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev.device_kind})")
+
+    CAPACITY = 1 << 20
+    N_WINDOWS = 16
+    rng = np.random.default_rng(7)
+
+    for LANES in (8192, 16384, 32768):
+        mesh = make_mesh(jax.devices()[:1])
+        eng = RateLimitEngine(
+            mesh=mesh, capacity_per_shard=CAPACITY, batch_per_shard=LANES,
+            global_capacity=1024, global_batch_per_shard=128,
+            max_global_updates=128,
+        )
+        step = eng._step_fn
+        zipf = rng.zipf(1.1, size=(N_WINDOWS, LANES))
+        slots = ((zipf - 1) % CAPACITY).astype(np.int32)
+
+        batches = []
+        for i in range(N_WINDOWS):
+            s = slots[i]
+            batches.append(jax.device_put(kernel.WindowBatch(
+                slot=jnp.asarray(s[None, :]),
+                hits=jnp.ones((1, LANES), jnp.int64),
+                limit=jnp.full((1, LANES), 1_000_000, jnp.int64),
+                duration=jnp.full((1, LANES), 60_000, jnp.int64),
+                algo=jnp.asarray((s % 2).astype(np.int32)[None, :]),
+                is_init=jnp.zeros((1, LANES), bool),
+            )))
+        empty_g = jax.device_put(kernel.WindowBatch(*[
+            a[None, :] for a in kernel.WindowBatch.pad(eng.global_batch_per_shard)
+        ]))
+        gacc = jax.device_put(jnp.zeros((1, eng.global_batch_per_shard), jnp.int64))
+        G, Kg = eng.global_capacity, eng.max_global_updates
+        upd = jax.device_put((
+            jnp.full((Kg,), G, jnp.int32), jnp.zeros((Kg,), jnp.int64),
+            jnp.zeros((Kg,), jnp.int64), jnp.zeros((Kg,), jnp.int32),
+            jnp.full((Kg,), G, jnp.int32)))
+        ups = jax.device_put((
+            jnp.full((Kg,), G, jnp.int32), jnp.zeros((Kg,), jnp.int64),
+            jnp.zeros((Kg,), jnp.int64), jnp.zeros((Kg,), jnp.int64),
+            jnp.zeros((Kg,), jnp.int64), jnp.zeros((Kg,), jnp.int64),
+            jnp.zeros((Kg,), jnp.int32)))
+
+        state, gstate, gcfg = eng.state, eng.gstate, eng.gcfg
+        now = 1_700_000_000_000
+
+        def run(i, state, gstate, gcfg, t):
+            return step(state, gstate, gcfg, batches[i % N_WINDOWS], empty_g,
+                        gacc, upd, ups, jnp.int64(t))
+
+        for i in range(5):
+            state, out, gstate, gcfg, _ = run(i, state, gstate, gcfg, now + i)
+        jax.block_until_ready(out)
+
+        ITERS = 200
+        # blocking per window (old bench behavior)
+        t0 = time.perf_counter()
+        for i in range(ITERS):
+            state, out, gstate, gcfg, _ = run(i, state, gstate, gcfg, now + 5 + i)
+            jax.block_until_ready(out)
+        tb = time.perf_counter() - t0
+        # pipelined: keep <=DEPTH windows in flight, fetch results lagged
+        DEPTH = 4
+        pend = []
+        t0 = time.perf_counter()
+        for i in range(ITERS):
+            state, out, gstate, gcfg, _ = run(i, state, gstate, gcfg, now + 205 + i)
+            pend.append(out)
+            if len(pend) > DEPTH:
+                o = pend.pop(0)
+                jax.block_until_ready(o)  # serving would device_get + demux here
+        for o in pend:
+            jax.block_until_ready(o)
+        tp = time.perf_counter() - t0
+        # pipelined with device_get (full fetch cost)
+        pend = []
+        t0 = time.perf_counter()
+        for i in range(ITERS):
+            state, out, gstate, gcfg, _ = run(i, state, gstate, gcfg, now + 405 + i)
+            pend.append(out)
+            if len(pend) > DEPTH:
+                jax.device_get(pend.pop(0))
+        for o in pend:
+            jax.device_get(o)
+        tg = time.perf_counter() - t0
+        print(f"B={LANES:6d}: blocking {ITERS*LANES/tb/1e6:7.1f} M/s | "
+              f"pipelined(block) {ITERS*LANES/tp/1e6:7.1f} M/s | "
+              f"pipelined(get) {ITERS*LANES/tg/1e6:7.1f} M/s")
+
+    # ---- host path breakdown (B=8192 engine from last loop iter) ----
+    from gubernator_tpu.api.types import RateLimitReq
+    reqs = [RateLimitReq(name="b", unique_key=f"k{i}", hits=1, limit=100,
+                         duration=60_000) for i in range(1000)]
+    eng.process(reqs, now=now)
+    t0 = time.perf_counter()
+    for i in range(5):
+        eng.process(reqs, now=now + i)
+    print(f"host process(): {5*1000/(time.perf_counter()-t0):,.0f} dec/s")
+
+    # breakdown: pack only
+    import cProfile, pstats, io
+    pr = cProfile.Profile()
+    pr.enable()
+    for i in range(5):
+        eng.process(reqs, now=now + 100 + i)
+    pr.disable()
+    s = io.StringIO()
+    pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(18)
+    print(s.getvalue())
+
+
+if __name__ == "__main__":
+    main()

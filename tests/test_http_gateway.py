@@ -25,6 +25,9 @@ def http(loop):
         capacity_per_shard=512, batch_per_shard=128,
         global_capacity=128, global_batch_per_shard=32, max_global_updates=32))
     inst = Instance(conf)
+    # compile before the first request: wall-clock `now` + short durations
+    # mean a mid-test jit pause would expire live buckets
+    inst.engine.warmup()
     client = loop.run_until_complete(_make_client(inst))
     yield client
     loop.run_until_complete(client.close())
@@ -95,4 +98,25 @@ def test_metrics_endpoint(http, loop):
         text = await r.text()
         assert "cache_access_count" in text
         assert "guber_tpu_windows_total" in text
+    loop.run_until_complete(body())
+
+
+def test_metrics_export_live_cache_stats(http, loop):
+    """cache_size / cache_access_count reflect the engine at scrape time
+    (the reference's Collector pattern, cache/lru.go:160-172)."""
+    async def body():
+        await http.post("/v1/GetRateLimits", json={"requests": [
+            {"name": "m", "unique_key": "k1", "hits": 1, "limit": 5,
+             "duration": 60000}]})
+        await http.post("/v1/GetRateLimits", json={"requests": [
+            {"name": "m", "unique_key": "k1", "hits": 1, "limit": 5,
+             "duration": 60000}]})
+        r = await http.get("/metrics")
+        text = await r.text()
+        size = [l for l in text.splitlines()
+                if l.startswith("cache_size ")][0]
+        assert float(size.split()[1]) >= 1.0
+        hits = [l for l in text.splitlines()
+                if l.startswith('cache_access_count_total{type="hit"}')]
+        assert hits and float(hits[0].split()[1]) >= 1.0
     loop.run_until_complete(body())
