@@ -91,14 +91,14 @@ def main():
 
     # warmup (compile + arena fill)
     for i in range(3):
-        out, _ = dispatch(i, now + i * K)
+        out = dispatch(i, now + i * K)
     jax.block_until_ready(out)
 
     lat = []
     t0 = time.perf_counter()
     for i in range(ITERS):
         w0 = time.perf_counter()
-        out, _ = dispatch(i, now + (3 + i) * K)
+        out = dispatch(i, now + (3 + i) * K)
         # sync before the next dispatch — serving demuxes responses here
         jax.block_until_ready(out)
         lat.append(time.perf_counter() - w0)
@@ -119,14 +119,14 @@ def main():
     sg = jax.device_put(gbatch)
     sa = jax.device_put(gacc)
     for i in range(3):
-        eng.state, sout, eng.gstate, eng.gcfg, _ = eng._step_fn(
+        eng.state, sout, eng.gstate, eng.gcfg = eng._step_fn(
             eng.state, eng.gstate, eng.gcfg, sb, sg, sa, upd, ups,
             jnp.int64(now + 10_000 + i))
     jax.block_until_ready(sout)
     slat = []
     for i in range(50):
         w0 = time.perf_counter()
-        eng.state, sout, eng.gstate, eng.gcfg, _ = eng._step_fn(
+        eng.state, sout, eng.gstate, eng.gcfg = eng._step_fn(
             eng.state, eng.gstate, eng.gcfg, sb, sg, sa, upd, ups,
             jnp.int64(now + 20_000 + i))
         jax.block_until_ready(sout)

@@ -51,17 +51,17 @@ def measure_device(eng, kernel, jnp, jax, capacity, lanes, slots_fn, algo_fn,
     now = 1_700_000_000_000
     out = None
     for i in range(3):
-        state, out, gstate, gcfg, _ = step(state, gstate, gcfg,
-                                           batches[i % n_windows], empty_g,
-                                           gacc, upd, ups, jnp.int64(now + i))
+        state, out, gstate, gcfg = step(state, gstate, gcfg,
+                                        batches[i % n_windows], empty_g,
+                                        gacc, upd, ups, jnp.int64(now + i))
     jax.block_until_ready(out)
     lat = []
     t0 = time.perf_counter()
     for i in range(iters):
         w0 = time.perf_counter()
-        state, out, gstate, gcfg, _ = step(state, gstate, gcfg,
-                                           batches[i % n_windows], empty_g,
-                                           gacc, upd, ups, jnp.int64(now + 3 + i))
+        state, out, gstate, gcfg = step(state, gstate, gcfg,
+                                        batches[i % n_windows], empty_g,
+                                        gacc, upd, ups, jnp.int64(now + 3 + i))
         jax.block_until_ready(out)
         lat.append(time.perf_counter() - w0)
     total = time.perf_counter() - t0

@@ -168,6 +168,13 @@ class RateLimitEngine:
         self._buf = _PackedWindow(S, batch_per_shard, global_batch_per_shard, max_global_updates)
         self._step_fn = self._build_step()
         self._multi_fn = _compiled_multi_step(self.mesh)
+        self._compact_fn = _compiled_step_compact(self.mesh)
+        # Sound-saturation guard for the compact wire format: once any
+        # out-of-range config enters the arena via the full path, stored
+        # limits/durations may exceed what the compact response can carry, so
+        # compact dispatch is disabled for the engine's lifetime (see the
+        # format note in ops/kernel.py).
+        self._compact_enabled = True
         self.windows_processed = 0
         self.decisions_processed = 0
 
@@ -289,24 +296,7 @@ class RateLimitEngine:
         for i, slot in enumerate(greset):
             buf.rslot[i] = slot
 
-        batch = WindowBatch(
-            slot=buf.slot, hits=buf.hits, limit=buf.limit,
-            duration=buf.duration, algo=buf.algo, is_init=buf.is_init,
-        )
-        gbatch = WindowBatch(
-            slot=buf.gslot, hits=buf.ghits, limit=buf.glimit,
-            duration=buf.gduration, algo=buf.galgo, is_init=buf.gis_init,
-        )
-        upd = (buf.uslot, buf.ulimit, buf.uduration, buf.ualgo, buf.rslot)
-        ups = (buf.pslot, buf.plimit, buf.pduration, buf.premaining,
-               buf.ptstamp, buf.pexpire, buf.palgo)
-
-        self.state, out, self.gstate, self.gcfg, gout = self._step_fn(
-            self.state, self.gstate, self.gcfg, batch, gbatch, buf.ghits_acc,
-            upd, ups, jnp.int64(now),
-        )
-        out = jax.device_get(out)
-        gout = jax.device_get(gout)
+        out, gout = self._dispatch(now)
 
         self.windows_processed += 1
         self.decisions_processed += len(requests)
@@ -483,7 +473,8 @@ class RateLimitEngine:
         upd,
         ups,
         nows,
-    ) -> tuple[WindowOutput, WindowOutput]:
+        compact_safe: bool = False,
+    ) -> jax.Array:
         """Apply K stacked windows in one device dispatch (see
         _compiled_multi_step).  All arguments carry a leading K dimension
         except upd/ups (control plane, applied ONCE, before window 0) — so
@@ -491,11 +482,20 @@ class RateLimitEngine:
         the control-plane writes; callers with upserts destined for a later
         window must split the dispatch at that window.
 
-        Inputs may be numpy or device arrays; outputs are device arrays
-        ([K, S, B*] per field) left un-fetched so callers can overlap demux
-        with the next dispatch.
+        Inputs may be numpy or device arrays.  Returns the fused response
+        array (i64[K, S, B+Bg, 4], see kernel.pack_outputs) left un-fetched
+        so callers can overlap demux with the next dispatch; split it with
+        kernel.split_outputs(jax.device_get(fused), batch_per_shard).
+
+        This path performs NO range checks on the stacked lanes (they may be
+        resident device arrays), so unless the caller asserts
+        `compact_safe=True` — promising every lane satisfies the
+        COMPACT_MAX_* ranges — compact dispatch is permanently disabled to
+        keep the saturation guard sound (see ops/kernel.py format note).
         """
-        self.state, out, self.gstate, self.gcfg, gout = self._multi_fn(
+        if not compact_safe:
+            self._compact_enabled = False
+        self.state, fused, self.gstate, self.gcfg = self._multi_fn(
             self.state, self.gstate, self.gcfg, batches, gbatches, gaccs,
             upd, ups, nows,
         )
@@ -503,7 +503,7 @@ class RateLimitEngine:
         self.windows_processed += k
         lanes = int(np.prod(batches.slot.shape[1:]))
         self.decisions_processed += k * lanes
-        return out, gout
+        return fused
 
     def empty_control(self):
         """(gbatch, gacc, upd, ups) padding values for windows that carry no
@@ -529,21 +529,53 @@ class RateLimitEngine:
         return gbatch, gacc, upd, ups
 
     def warmup(self) -> None:
-        """Compile and execute one empty window so serving never pays the jit.
+        """Compile and execute one empty window per serving executable (full
+        and compact) so serving never pays the jit.
 
         (An empty `process()` call is a no-op on the native path, so callers
         that need the compile — cluster boot, daemon start — use this.)"""
+        saved = self._compact_enabled
+        self._compact_enabled = False
+        self._buf.reset(self.global_capacity)
+        self._dispatch(millisecond_now())
+        self._compact_enabled = saved
         self._buf.reset(self.global_capacity)
         self._dispatch(millisecond_now())
 
+    def _compact_eligible(self, buf) -> bool:
+        """May this window travel in the compact wire format?  Vectorized
+        range checks over the staged buffers (padded lanes are zeros and
+        always pass).
+
+        A limit/duration violation disables compact dispatch permanently —
+        those values persist in the arena and could later saturate a compact
+        response.  A hits violation only routes THIS window to the full
+        path: hits are consumed, not stored."""
+        if not self._compact_enabled:
+            return False
+        cfg_ok = (
+            bool((buf.limit >= 0).all())
+            and bool((buf.limit < kernel.COMPACT_MAX_LIMIT).all())
+            and bool((buf.duration >= 0).all())
+            and bool((buf.duration < kernel.COMPACT_MAX_DURATION).all())
+        )
+        if not cfg_ok:
+            self._compact_enabled = False
+            return False
+        return (
+            bool((buf.hits >= 0).all())
+            and bool((buf.hits < kernel.COMPACT_MAX_HITS).all())
+        )
+
     def _dispatch(self, now: int):
         """Run the staged buffers through the device step; returns host copies
-        of the (regular, global) outputs."""
+        of the (regular, global) outputs.
+
+        The transfer is the dominant per-window fixed cost (catastrophically
+        so on a tunneled chip; PCIe-bound otherwise), so eligible windows use
+        the compact wire format (_compiled_step_compact) and everything else
+        a single fused fetch (_compiled_step)."""
         buf = self._buf
-        batch = WindowBatch(
-            slot=buf.slot, hits=buf.hits, limit=buf.limit,
-            duration=buf.duration, algo=buf.algo, is_init=buf.is_init,
-        )
         gbatch = WindowBatch(
             slot=buf.gslot, hits=buf.ghits, limit=buf.glimit,
             duration=buf.gduration, algo=buf.galgo, is_init=buf.gis_init,
@@ -551,11 +583,30 @@ class RateLimitEngine:
         upd = (buf.uslot, buf.ulimit, buf.uduration, buf.ualgo, buf.rslot)
         ups = (buf.pslot, buf.plimit, buf.pduration, buf.premaining,
                buf.ptstamp, buf.pexpire, buf.palgo)
-        self.state, out, self.gstate, self.gcfg, gout = self._step_fn(
+        if self._compact_eligible(buf):
+            packed = kernel.encode_batch_host(
+                buf.slot, buf.hits, buf.limit, buf.duration, buf.algo,
+                buf.is_init)
+            self.state, cword, gfused, self.gstate, self.gcfg = self._compact_fn(
+                self.state, self.gstate, self.gcfg, packed, gbatch,
+                buf.ghits_acc, upd, ups, jnp.int64(now),
+            )
+            cword, gfused = jax.device_get((cword, gfused))
+            out = kernel.decode_output_host(cword, now)
+            gout = WindowOutput(
+                status=gfused[..., 0], limit=gfused[..., 1],
+                remaining=gfused[..., 2], reset_time=gfused[..., 3])
+            return out, gout
+        batch = WindowBatch(
+            slot=buf.slot, hits=buf.hits, limit=buf.limit,
+            duration=buf.duration, algo=buf.algo, is_init=buf.is_init,
+        )
+        self.state, fused, self.gstate, self.gcfg = self._step_fn(
             self.state, self.gstate, self.gcfg, batch, gbatch, buf.ghits_acc,
             upd, ups, jnp.int64(now),
         )
-        return jax.device_get(out), jax.device_get(gout)
+        return kernel.split_outputs(
+            jax.device_get(fused), self.batch_per_shard)
 
     def process(
         self,
@@ -708,10 +759,9 @@ def _compiled_step(mesh: Mesh):
             expand = lambda a: a[None]
             return (
                 BucketState(*jax.tree.map(expand, new_st)),
-                WindowOutput(*jax.tree.map(expand, out)),
+                kernel.pack_outputs(out, gout)[None],
                 new_g,
                 gcfg,
-                WindowOutput(*jax.tree.map(expand, gout)),
             )
 
     state_sharded = BucketState(*[P(SHARD_AXIS)] * 6)
@@ -732,10 +782,68 @@ def _compiled_step(mesh: Mesh):
         ),
         out_specs=(
             state_sharded,
-            WindowOutput(*[P(SHARD_AXIS)] * 4),
+            P(SHARD_AXIS),
             state_repl,
             GlobalConfig(*[P()] * 3),
-            WindowOutput(*[P(SHARD_AXIS)] * 4),
+        ),
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1, 2))
+
+
+@lru_cache(maxsize=None)
+def _compiled_step_compact(mesh: Mesh):
+    """The serving fast path: compact request/response wire format.
+
+    Same computation as _compiled_step, but the regular-key window crosses
+    host<->device packed (kernel.decode_batch / encode_output_compact — 16B
+    up + 8B down per lane instead of ~41B + 32B), cutting the per-window
+    transfer cost ~3x.  GLOBAL lanes keep the full format: they are few
+    (Bg ≈ 128) and their stored state may carry configs that predate the
+    host's range checks, so they are exempt from compact saturation rules.
+    """
+    def shard_fn(state, gstate, gcfg, packed, gbatch, gacc, upd, ups, now):
+        st = BucketState(*jax.tree.map(lambda a: a[0], state))
+        bt = kernel.decode_batch(packed[0])
+        new_st, out = kernel.window_step(st, bt, now)
+
+        gstate, gcfg = _apply_control(gstate, gcfg, upd, ups)
+        gb = WindowBatch(*jax.tree.map(lambda a: a[0], gbatch))
+        new_g, gout = _global_window(gstate, gcfg, gb, gacc[0], now)
+
+        expand = lambda a: a[None]
+        gfused = jnp.stack(
+            [gout.status.astype(jnp.int64), gout.limit, gout.remaining,
+             gout.reset_time], axis=-1)
+        return (
+            BucketState(*jax.tree.map(expand, new_st)),
+            kernel.encode_output_compact(out, now)[None],
+            gfused[None],
+            new_g,
+            gcfg,
+        )
+
+    state_sharded = BucketState(*[P(SHARD_AXIS)] * 6)
+    state_repl = BucketState(*[P()] * 6)
+    sharded = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            state_sharded,
+            state_repl,
+            GlobalConfig(*[P()] * 3),
+            P(SHARD_AXIS),
+            WindowBatch(*[P(SHARD_AXIS)] * 6),
+            P(SHARD_AXIS),
+            (P(), P(), P(), P(), P()),
+            (P(),) * 7,
+            P(),
+        ),
+        out_specs=(
+            state_sharded,
+            P(SHARD_AXIS),
+            P(SHARD_AXIS),
+            state_repl,
+            GlobalConfig(*[P()] * 3),
         ),
     )
     return jax.jit(sharded, donate_argnums=(0, 1, 2))
@@ -771,20 +879,18 @@ def _compiled_multi_step(mesh: Mesh):
             st, out = kernel.window_step(st, bt, now)
             gbt = WindowBatch(*jax.tree.map(lambda a: a[0], gb))
             gst, gout = _global_window(gst, gcfg, gbt, gacc[0], now)
-            return (st, gst), (out, gout)
+            return (st, gst), kernel.pack_outputs(out, gout)
 
-        (st, gst), (outs, gouts) = lax.scan(
+        (st, gst), fused = lax.scan(
             body, (st, gstate), (batches, gbatches, gaccs, nows)
         )
         expand = lambda a: a[None]
-        # outs: [K, B] per field -> [K, 1, B] so the shard axis is explicit
-        expand_mid = lambda a: a[:, None]
+        # fused: [K, B+Bg, 4] -> [K, 1, B+Bg, 4] so the shard axis is explicit
         return (
             BucketState(*jax.tree.map(expand, st)),
-            WindowOutput(*jax.tree.map(expand_mid, outs)),
+            fused[:, None],
             gst,
             gcfg,
-            WindowOutput(*jax.tree.map(expand_mid, gouts)),
         )
 
     state_sharded = BucketState(*[P(SHARD_AXIS)] * 6)
@@ -806,10 +912,9 @@ def _compiled_multi_step(mesh: Mesh):
         ),
         out_specs=(
             state_sharded,
-            WindowOutput(*[stackedP] * 4),
+            stackedP,
             state_repl,
             GlobalConfig(*[P()] * 3),
-            WindowOutput(*[stackedP] * 4),
         ),
     )
     return jax.jit(sharded, donate_argnums=(0, 1, 2))

@@ -445,6 +445,122 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
     return new_state, unsorted
 
 
+def pack_outputs(out: WindowOutput, gout: WindowOutput) -> jax.Array:
+    """Fuse both windows' responses into one i64[B+Bg, 4] array.
+
+    Lane rows: the regular window's B lanes then the GLOBAL window's Bg
+    lanes; columns (status, limit, remaining, reset_time).  One fused array
+    means the host pays ONE device→host round trip per dispatch instead of
+    eight — on a tunneled chip that round trip (~20ms) dominates the whole
+    serving window, and even on PCIe it cuts per-window fixed costs.
+    """
+    o = jnp.stack(
+        [out.status.astype(I64), out.limit, out.remaining, out.reset_time],
+        axis=-1)
+    g = jnp.stack(
+        [gout.status.astype(I64), gout.limit, gout.remaining, gout.reset_time],
+        axis=-1)
+    return jnp.concatenate([o, g], axis=0)
+
+
+def split_outputs(fused, lanes: int) -> tuple[WindowOutput, WindowOutput]:
+    """Host-side inverse of pack_outputs over [..., B+Bg, 4] numpy buffers:
+    returns (regular, GLOBAL) WindowOutputs as zero-copy views."""
+    def unpack(a):
+        return WindowOutput(
+            status=a[..., 0], limit=a[..., 1],
+            remaining=a[..., 2], reset_time=a[..., 3])
+    return unpack(fused[..., :lanes, :]), unpack(fused[..., lanes:, :])
+
+
+# ---- compact wire format -------------------------------------------------
+# The host<->device transfer is the serving path's fixed cost per window (on
+# a tunneled chip it IS the window cost; on PCIe it still bounds small-window
+# latency).  Eligible windows (host-checked: 0 <= hits < 2^28,
+# 0 <= limit < 2^31, 0 <= duration < 2^31-16) travel packed:
+#
+#   request  i64[B, 2]:
+#     w0: bits 0..31 slot+1 (0 = padded lane), bit 32 is_init,
+#         bit 33 algorithm, bits 34..61 hits
+#     w1: bits 0..31 limit, bits 32..62 duration
+#   response i64[B, 2]:
+#     w0: bits 0..30 remaining, bit 31 status,
+#         bits 32..63 reset_enc = 0 if reset_time == 0 else reset_time - now + 1
+#     w1: the response's limit, raw — it is the STORED limit on hit paths
+#         (a live bucket keeps its init-time config, algorithms.go:40-65), so
+#         it can exceed the request-side range checks and can't be dropped or
+#         packed.
+#
+# Windows that fail the range checks use the full WindowBatch/pack_outputs
+# path, so the compact path is lossless: remaining <= stored limit and
+# reset - now <= stored duration always, and the engine permanently drops to
+# the full path the first time an out-of-range config enters the arena
+# (RateLimitEngine._dispatch), so compact windows only ever read state whose
+# stored configs passed the same checks.
+
+COMPACT_MAX_HITS = 1 << 28
+COMPACT_MAX_LIMIT = 1 << 31
+COMPACT_MAX_DURATION = (1 << 31) - 16
+
+
+def decode_batch(packed) -> WindowBatch:
+    """Device-side decode of the compact request pair (see layout above)."""
+    w0 = packed[..., 0]
+    w1 = packed[..., 1]
+    return WindowBatch(
+        slot=(w0 & 0xFFFFFFFF).astype(I32) - 1,
+        hits=(w0 >> 34) & (COMPACT_MAX_HITS - 1),
+        limit=w1 & 0xFFFFFFFF,
+        duration=(w1 >> 32) & 0x7FFFFFFF,
+        algo=((w0 >> 33) & 1).astype(I32),
+        is_init=((w0 >> 32) & 1).astype(jnp.bool_),
+    )
+
+
+def encode_batch_host(slot, hits, limit, duration, algo, is_init):
+    """Host-side (numpy) encode into the compact request pair.
+
+    Caller must have verified the COMPACT_MAX_* ranges; padded lanes
+    (slot == PAD_SLOT) encode to w0 == 0 regardless of other fields."""
+    import numpy as np
+
+    pad = slot < 0
+    w0 = ((slot.astype(np.int64) + 1)
+          | (is_init.astype(np.int64) << 32)
+          | (algo.astype(np.int64) << 33)
+          | (hits << 34))
+    w0 = np.where(pad, 0, w0)
+    w1 = limit | (duration << 32)
+    return np.stack([w0, w1], axis=-1)
+
+
+def encode_output_compact(out: WindowOutput, now) -> jax.Array:
+    """Device-side encode of responses into i64[B, 2] (packed word, limit)."""
+    reset_enc = jnp.where(
+        out.reset_time == 0,
+        jnp.int64(0),
+        jnp.clip(out.reset_time - now, 0, (1 << 31) - 2) + 1,
+    )
+    word = ((reset_enc << 32)
+            | (out.status.astype(I64) << 31)
+            | jnp.clip(out.remaining, 0, (1 << 31) - 1))
+    return jnp.stack([word, out.limit], axis=-1)
+
+
+def decode_output_host(packed, now) -> WindowOutput:
+    """Host-side (numpy) decode of the compact response pair."""
+    import numpy as np
+
+    word = packed[..., 0]
+    enc = (word >> 32) & 0xFFFFFFFF
+    return WindowOutput(
+        status=(word >> 31) & 1,
+        limit=packed[..., 1],
+        remaining=word & 0x7FFFFFFF,
+        reset_time=np.where(enc == 0, 0, now + enc - 1),
+    )
+
+
 def global_read(state: BucketState, batch: WindowBatch, now) -> WindowOutput:
     """Answer GLOBAL-behavior requests from the local replica without mutating it.
 

@@ -47,20 +47,11 @@ def main():
             algo=jnp.asarray((s % 2).astype(np.int32)[None, :]),
             is_init=jnp.zeros((1, LANES), bool),
         )))
-    empty_g = jax.device_put(kernel.WindowBatch(*[
-        a[None, :] for a in kernel.WindowBatch.pad(eng.global_batch_per_shard)
-    ]))
-    gacc = jax.device_put(jnp.zeros((1, eng.global_batch_per_shard), jnp.int64))
-    G, Kg = eng.global_capacity, eng.max_global_updates
-    upd = jax.device_put((
-        jnp.full((Kg,), G, jnp.int32), jnp.zeros((Kg,), jnp.int64),
-        jnp.zeros((Kg,), jnp.int64), jnp.zeros((Kg,), jnp.int32),
-        jnp.full((Kg,), G, jnp.int32)))
-    ups = jax.device_put((
-        jnp.full((Kg,), G, jnp.int32), jnp.zeros((Kg,), jnp.int64),
-        jnp.zeros((Kg,), jnp.int64), jnp.zeros((Kg,), jnp.int64),
-        jnp.zeros((Kg,), jnp.int64), jnp.zeros((Kg,), jnp.int64),
-        jnp.zeros((Kg,), jnp.int32)))
+    gbatch, gacc, upd, ups = eng.empty_control()
+    empty_g = jax.device_put(gbatch)
+    gacc = jax.device_put(gacc)
+    upd = jax.device_put(upd)
+    ups = jax.device_put(ups)
 
     state, gstate, gcfg = eng.state, eng.gstate, eng.gcfg
     now = 1_700_000_000_000
@@ -70,7 +61,7 @@ def main():
                     gacc, upd, ups, jnp.int64(t))
 
     for i in range(5):
-        state, out, gstate, gcfg, _ = run(i, state, gstate, gcfg, now + i)
+        state, out, gstate, gcfg = run(i, state, gstate, gcfg, now + i)
     jax.block_until_ready(out)
 
     ITERS = 100
@@ -78,7 +69,7 @@ def main():
     t0 = time.perf_counter()
     for i in range(ITERS):
         w0 = time.perf_counter()
-        state, out, gstate, gcfg, _ = run(i, state, gstate, gcfg, now + 5 + i)
+        state, out, gstate, gcfg = run(i, state, gstate, gcfg, now + 5 + i)
         jax.block_until_ready(out)
         lat.append(time.perf_counter() - w0)
     tb = time.perf_counter() - t0

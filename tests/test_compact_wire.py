@@ -1,0 +1,159 @@
+"""Compact wire format must be response-identical to the full path.
+
+Drives identical randomized request streams through two engines — one with
+compact dispatch force-disabled — and compares every response field, plus the
+permanent fallback once an out-of-range config appears.
+"""
+
+import numpy as np
+import pytest
+
+import gubernator_tpu  # noqa: F401
+
+from gubernator_tpu.api.types import Algorithm, Behavior, RateLimitReq, Status
+from gubernator_tpu.core.engine import RateLimitEngine
+from gubernator_tpu.ops import kernel
+
+T0 = 1_700_000_000_000
+
+
+def make_engine(native):
+    return RateLimitEngine(
+        capacity_per_shard=256,
+        batch_per_shard=64,
+        global_capacity=32,
+        global_batch_per_shard=16,
+        max_global_updates=16,
+        use_native=native,
+    )
+
+
+def random_stream(rng, n_windows=6, n_reqs=40):
+    wins = []
+    for w in range(n_windows):
+        reqs = []
+        for _ in range(n_reqs):
+            reqs.append(RateLimitReq(
+                name="cw",
+                unique_key=f"k{rng.integers(0, 25)}",
+                hits=int(rng.integers(0, 4)),
+                limit=int(rng.integers(1, 9)),
+                duration=int(rng.choice([50, 200, 10_000])),
+                algorithm=int(rng.integers(0, 2)),
+                behavior=(Behavior.GLOBAL if rng.random() < 0.15
+                          else Behavior.BATCHING),
+            ))
+        wins.append(reqs)
+    return wins
+
+
+@pytest.mark.parametrize("native", [False, "auto"])
+def test_compact_equals_full(native):
+    rng = np.random.default_rng(11)
+    wins = random_stream(rng)
+    ea = make_engine(native)   # full only
+    ea._compact_enabled = False
+    eb = make_engine(native)   # compact
+    for w, reqs in enumerate(wins):
+        now = T0 + w * 60  # crosses the 50ms duration -> expiry mid-stream
+        ra = ea.process(reqs, now=now)
+        rb = eb.process(reqs, now=now)
+        assert eb._compact_enabled, "stream should stay compact-eligible"
+        for i, (a, b) in enumerate(zip(ra, rb)):
+            assert (a.status, a.limit, a.remaining, a.reset_time) == \
+                   (b.status, b.limit, b.remaining, b.reset_time), \
+                   f"window {w} req {i}: {a} != {b}"
+
+
+def test_out_of_range_falls_back_permanently():
+    eng = make_engine(False)
+    assert eng._compact_enabled
+    big = RateLimitReq(name="cw", unique_key="huge", hits=1,
+                       limit=(1 << 40), duration=60_000)
+    r = eng.process([big], now=T0)[0]
+    assert r.limit == 1 << 40 and r.remaining == (1 << 40) - 1
+    assert not eng._compact_enabled
+    # stored big config now answers exactly through the full path
+    r = eng.process([RateLimitReq(name="cw", unique_key="huge", hits=1,
+                                  limit=5, duration=60_000)], now=T0 + 1)[0]
+    # live bucket keeps its init-time config (reference token hit path)
+    assert r.limit == 1 << 40 and r.remaining == (1 << 40) - 2
+    assert not eng._compact_enabled
+
+
+def test_negative_hits_fall_back_transiently():
+    """hits violations route one window to the full path but do NOT disable
+    compact (hits are consumed, not stored in the arena)."""
+    eng = make_engine(False)
+    r = eng.process([RateLimitReq(name="cw", unique_key="n", hits=-1, limit=5,
+                                  duration=60_000)], now=T0)[0]
+    assert r.remaining == 6  # reference arithmetic: limit - hits
+    assert eng._compact_enabled
+    r = eng.process([RateLimitReq(name="cw", unique_key="n", hits=1, limit=5,
+                                  duration=60_000)], now=T0 + 1)[0]
+    assert r.remaining == 5
+
+
+def test_step_windows_disables_compact_unless_safe():
+    eng = make_engine(False)
+    gbatch, gacc, upd, ups = eng.empty_control()
+    stack4 = lambda a: np.stack([a] * 2)
+    batches = kernel.WindowBatch(*[stack4(np.asarray(getattr(
+        kernel.WindowBatch(
+            slot=np.full((8, 64), kernel.PAD_SLOT, np.int32),
+            hits=np.zeros((8, 64), np.int64),
+            limit=np.zeros((8, 64), np.int64),
+            duration=np.zeros((8, 64), np.int64),
+            algo=np.zeros((8, 64), np.int32),
+            is_init=np.zeros((8, 64), bool),
+        ), f))) for f in kernel.WindowBatch._fields])
+    gb = kernel.WindowBatch(*[stack4(getattr(gbatch, f))
+                              for f in gbatch._fields])
+    ga = stack4(gacc)
+    nows = np.asarray([T0, T0 + 1], np.int64)
+    eng.step_windows(batches, gb, ga, upd, ups, nows, compact_safe=True)
+    assert eng._compact_enabled
+    eng.step_windows(batches, gb, ga, upd, ups, nows)
+    assert not eng._compact_enabled
+
+
+def test_wire_roundtrip_exact():
+    """encode_batch_host -> decode_batch and encode_output_compact ->
+    decode_output_host are exact inverses over the eligible ranges."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    B = 128
+    slot = rng.integers(-1, 1000, size=B).astype(np.int32)
+    hits = rng.integers(0, kernel.COMPACT_MAX_HITS, size=B).astype(np.int64)
+    limit = rng.integers(0, kernel.COMPACT_MAX_LIMIT, size=B).astype(np.int64)
+    duration = rng.integers(0, kernel.COMPACT_MAX_DURATION, size=B).astype(np.int64)
+    algo = rng.integers(0, 2, size=B).astype(np.int32)
+    is_init = rng.random(B) < 0.3
+    packed = kernel.encode_batch_host(slot, hits, limit, duration, algo, is_init)
+    dec = jax.jit(kernel.decode_batch)(jnp.asarray(packed))
+    pad = slot < 0
+    np.testing.assert_array_equal(np.asarray(dec.slot)[~pad], slot[~pad])
+    assert np.all(np.asarray(dec.slot)[pad] == kernel.PAD_SLOT)
+    for name, ref in (("hits", hits), ("limit", limit),
+                      ("duration", duration), ("algo", algo),
+                      ("is_init", is_init)):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(dec, name))[~pad], ref[~pad], err_msg=name)
+
+    now = T0
+    out = kernel.WindowOutput(
+        status=rng.integers(0, 2, size=B).astype(np.int32),
+        limit=rng.integers(0, 1 << 62, size=B).astype(np.int64),
+        remaining=rng.integers(0, 1 << 31, size=B).astype(np.int64),
+        reset_time=np.where(rng.random(B) < 0.2, 0,
+                            now + rng.integers(0, kernel.COMPACT_MAX_DURATION,
+                                               size=B)).astype(np.int64),
+    )
+    word = np.asarray(jax.jit(kernel.encode_output_compact)(
+        kernel.WindowOutput(*[jnp.asarray(a) for a in out]), jnp.int64(now)))
+    dec = kernel.decode_output_host(word, now)
+    for f in kernel.WindowOutput._fields:
+        np.testing.assert_array_equal(getattr(dec, f), getattr(out, f),
+                                      err_msg=f)

@@ -79,36 +79,36 @@ def test_stacked_equals_sequential(seed):
     upd[1][:2] = 20
     upd[2][:2] = 10_000
     upd[4][:2] = [3, 7]
-    seq_outs, seq_gouts = [], []
+    seq_fused = []
     for i in range(K):
         u = upd if i == 0 else (np.full_like(upd[0], ea.global_capacity),
                                 upd[1] * 0, upd[2] * 0, upd[3] * 0,
                                 np.full_like(upd[4], ea.global_capacity))
-        ea.state, out, ea.gstate, ea.gcfg, gout = ea._step_fn(
+        ea.state, fused, ea.gstate, ea.gcfg = ea._step_fn(
             ea.state, ea.gstate, ea.gcfg, batches[i], gbatches[i], gaccs[i],
             u, ups, jnp.int64(nows[i]),
         )
-        seq_outs.append(jax.device_get(out))
-        seq_gouts.append(jax.device_get(gout))
+        seq_fused.append(jax.device_get(fused))
 
     # engine B: one stacked dispatch
     eb = make_engine()
     stack = lambda ws: type(ws[0])(*[
         np.stack([getattr(w, f) for w in ws]) for f in ws[0]._fields])
-    outs, gouts = eb.step_windows(
+    fused = eb.step_windows(
         stack(batches), stack(gbatches), np.stack(gaccs),
         upd, ups, np.asarray(nows, np.int64),
     )
-    outs = jax.device_get(outs)
-    gouts = jax.device_get(gouts)
+    fused = jax.device_get(fused)
 
     for i in range(K):
+        outs, gouts = kernel.split_outputs(fused[i], B)
+        seq_out, seq_gout = kernel.split_outputs(seq_fused[i], B)
         for f in kernel.WindowOutput._fields:
             np.testing.assert_array_equal(
-                getattr(outs, f)[i], getattr(seq_outs[i], f),
+                getattr(outs, f), getattr(seq_out, f),
                 err_msg=f"window {i} field {f}")
             np.testing.assert_array_equal(
-                getattr(gouts, f)[i], getattr(seq_gouts[i], f),
+                getattr(gouts, f), getattr(seq_gout, f),
                 err_msg=f"window {i} GLOBAL field {f}")
 
     # final arena state identical
