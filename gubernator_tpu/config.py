@@ -96,6 +96,30 @@ class DaemonConfig:
     etcd_dial_timeout: float = 5.0
     etcd_username: str = ""
     etcd_password: str = ""
+    # etcd TLS (reference cmd/gubernator/config.go:149-192): any
+    # GUBER_ETCD_TLS_* variable enables TLS; CA/cert/key are file paths.
+    etcd_tls_enabled: bool = False
+    etcd_tls_cert: str = ""
+    etcd_tls_key: str = ""
+    etcd_tls_ca: str = ""
+    etcd_tls_skip_verify: bool = False
+
+    def etcd_ssl_context(self):
+        """Build the ssl.SSLContext for the etcd gateway connection, or None
+        when TLS is disabled (the setupTLS analog, config.go:149-192)."""
+        if not self.etcd_tls_enabled:
+            return None
+        import ssl
+
+        ctx = ssl.create_default_context()
+        if self.etcd_tls_ca:
+            ctx.load_verify_locations(cafile=self.etcd_tls_ca)
+        if self.etcd_tls_cert and self.etcd_tls_key:
+            ctx.load_cert_chain(self.etcd_tls_cert, self.etcd_tls_key)
+        if self.etcd_tls_skip_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        return ctx
 
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
@@ -153,6 +177,15 @@ def config_from_env(env_file: Optional[str] = None) -> DaemonConfig:
     c.etcd_dial_timeout = float(_env("GUBER_ETCD_DIAL_TIMEOUT", "5"))
     c.etcd_username = _env("GUBER_ETCD_USER")
     c.etcd_password = _env("GUBER_ETCD_PASSWORD")
+
+    # any GUBER_ETCD_TLS_* var switches the connection to TLS
+    # (reference config.go:136-140 anyHasPrefix)
+    c.etcd_tls_enabled = any(k.startswith("GUBER_ETCD_TLS_") for k in os.environ)
+    c.etcd_tls_cert = _env("GUBER_ETCD_TLS_CERT")
+    c.etcd_tls_key = _env("GUBER_ETCD_TLS_KEY")
+    c.etcd_tls_ca = _env("GUBER_ETCD_TLS_CA")
+    c.etcd_tls_skip_verify = _env("GUBER_ETCD_TLS_SKIP_VERIFY").lower() in (
+        "true", "1", "yes")
 
     # reference config.go:118-133: the two discovery backends are exclusive
     if c.k8s_enabled and c.etcd_enabled:

@@ -134,12 +134,38 @@ class RateLimitEngine:
         self.global_batch_per_shard = global_batch_per_shard
         self.max_global_updates = max_global_updates
 
+        # Mesh mode (parallel/distributed.py): the mesh spans processes;
+        # this host stages lanes only for its contiguous run of shards and
+        # reads back only its addressable output blocks.  All processes must
+        # dispatch in lockstep.
+        from gubernator_tpu.parallel.distributed import local_device_indices
+        local_ids = local_device_indices(self.mesh)
+        self.multiprocess = len(local_ids) != self.mesh.devices.size
+        self.num_local_shards = len(local_ids)
+        self.local_shard_offset = min(local_ids) if local_ids else 0
+        if self.multiprocess:
+            if local_ids != list(range(self.local_shard_offset,
+                                       self.local_shard_offset + len(local_ids))):
+                raise ValueError(
+                    "mesh mode needs each process's devices contiguous on the "
+                    "shard axis (default jax.devices() order satisfies this)")
+            # dynamic GLOBAL registration and gRPC upserts would diverge the
+            # replicated arena across processes — see step()/register_global_keys
+            self._dynamic_global = False
+        else:
+            self._dynamic_global = True
+
         S, C, G = self.num_shards, capacity_per_shard, global_capacity
         shard_sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
         repl_sharding = NamedSharding(self.mesh, P())
+        self._shard_sharding = shard_sharding
+        self._repl_sharding = repl_sharding
 
         def sharded_zeros(shape, dtype, sharding):
-            return jax.device_put(jnp.zeros(shape, dtype), sharding)
+            # compiled constant: works when the sharding spans non-addressable
+            # devices (multi-host), unlike device_put of a host array
+            return jax.jit(lambda: jnp.zeros(shape, dtype),
+                           out_shardings=sharding)()
 
         self.state = BucketState(
             limit=sharded_zeros((S, C), jnp.int64, shard_sharding),
@@ -163,9 +189,12 @@ class RateLimitEngine:
             algo=sharded_zeros((G,), jnp.int32, repl_sharding),
         )
 
-        self.tables = [SlotTable(C) for _ in range(S)]
+        # host routing state covers local shards only (all of them when
+        # single-process)
+        self.tables = [SlotTable(C) for _ in range(self.num_local_shards)]
         self.gtable = SlotTable(G)
-        self._buf = _PackedWindow(S, batch_per_shard, global_batch_per_shard, max_global_updates)
+        self._buf = _PackedWindow(self.num_local_shards, batch_per_shard,
+                                  global_batch_per_shard, max_global_updates)
         self._step_fn = self._build_step()
         self._multi_fn = _compiled_multi_step(self.mesh)
         self._compact_fn = _compiled_step_compact(self.mesh)
@@ -173,8 +202,11 @@ class RateLimitEngine:
         # out-of-range config enters the arena via the full path, stored
         # limits/durations may exceed what the compact response can carry, so
         # compact dispatch is disabled for the engine's lifetime (see the
-        # format note in ops/kernel.py).
-        self._compact_enabled = True
+        # format note in ops/kernel.py).  Mesh mode always uses the full
+        # format: eligibility is a per-host data-dependent choice, and hosts
+        # picking different executables for the same lockstep window would
+        # wedge the collectives.
+        self._compact_enabled = not self.multiprocess
         self.windows_processed = 0
         self.decisions_processed = 0
 
@@ -183,7 +215,12 @@ class RateLimitEngine:
         # the per-key Python dict path.  The two backends are exclusive —
         # regular-key routing state lives in exactly one of them.
         self.native = None
-        if use_native in ("auto", True, "on"):
+        if self.multiprocess:
+            # the C router hashes keys straight to global shard lanes; its
+            # local-shard remapping is wired up in a later round
+            if use_native not in ("auto", False, "off"):
+                raise RuntimeError("native router not yet supported in mesh mode")
+        elif use_native in ("auto", True, "on"):
             from gubernator_tpu import native as native_mod
             if native_mod.available():
                 self.native = native_mod.NativeRouter(S, C)
@@ -218,18 +255,23 @@ class RateLimitEngine:
         before this window's reads.
 
         Caller must respect the window caps (use `process` for auto-chunking):
-        per-shard regular lanes <= batch_per_shard, per-shard GLOBAL lanes <=
-        global_batch_per_shard, distinct GLOBAL keys + upserts <=
+        per-shard regular lanes <= batch_per_shard, total GLOBAL lanes <=
+        num_local_shards * global_batch_per_shard (they spread round-robin
+        over local shards), distinct GLOBAL keys + upserts <=
         max_global_updates.
         """
         if self.native is not None:
             return self._process_native(requests, now, accumulate, upserts)
-        if now is None:
-            now = millisecond_now()
+        now = self._resolve_now(now)
         S = self.num_shards
         buf = self._buf
         buf.reset(self.global_capacity)
 
+        if upserts and not self._dynamic_global:
+            # gRPC-broadcast upserts are host-local writes; in mesh mode they
+            # would diverge the replicated arena across processes
+            raise ValueError("upserts are not supported in mesh mode "
+                             "(GLOBAL state replicates via the in-mesh psum)")
         if upserts:
             for i, u in enumerate(upserts):
                 slot, _ = self.gtable.lookup(u.key, now, u.duration)
@@ -248,8 +290,8 @@ class RateLimitEngine:
                 buf.pexpire[i] = st.reset_time if is_token else now + u.duration
                 buf.palgo[i] = u.algorithm
 
-        reg_fill = [0] * S
-        glob_fill = [0] * S
+        reg_fill = [0] * self.num_local_shards
+        glob_fill = [0] * self.num_local_shards
         # slot -> (limit, duration, algo): latest request's config wins within
         # the window (deduped host-side — a device scatter with duplicate
         # indices has no ordering guarantee)
@@ -258,16 +300,33 @@ class RateLimitEngine:
         # (shard, lane, is_global) per request, for demux
         lanes: List[tuple] = []
 
+        g_count = 0
         for i, r in enumerate(requests):
             key = r.hash_key()
-            s = shard_of(key, S)
             if r.behavior == Behavior.GLOBAL:
+                if not self._dynamic_global and key not in self.gtable:
+                    raise ValueError(
+                        f"GLOBAL key {key!r} is not registered; mesh mode "
+                        "requires register_global_keys at boot (identical on "
+                        "every process)")
                 slot, is_init = self.gtable.lookup(key, now, r.duration)
                 contribute = accumulate is None or accumulate[i]
-                if contribute:
+                if contribute and self._dynamic_global:
+                    # per-request config refresh diverges replicas in mesh
+                    # mode; there configs are fixed at registration
                     gcfg_upd[slot] = (r.limit, r.duration, r.algorithm)
                     if is_init:
                         greset.append(slot)
+                # GLOBAL lanes are shard-agnostic (the psum covers every
+                # shard), so spread them round-robin over LOCAL shards
+                if g_count >= self.num_local_shards * self.global_batch_per_shard:
+                    raise ValueError(
+                        "window exceeds the GLOBAL lane cap "
+                        f"({self.num_local_shards} local shards x "
+                        f"{self.global_batch_per_shard}); use process() for "
+                        "auto-chunking")
+                s = g_count % self.num_local_shards
+                g_count += 1
                 lane = glob_fill[s]
                 glob_fill[s] += 1
                 buf.gslot[s, lane] = slot
@@ -279,6 +338,12 @@ class RateLimitEngine:
                 buf.gis_init[s, lane] = is_init
                 lanes.append((s, lane, True))
             else:
+                s = shard_of(key, S) - self.local_shard_offset
+                if not 0 <= s < self.num_local_shards:
+                    raise ValueError(
+                        f"key {key!r} belongs to shard "
+                        f"{shard_of(key, S)}, not owned by this process — "
+                        "the serving layer must route it to the owning host")
                 slot, is_init = self.tables[s].lookup(key, now, r.duration)
                 lane = reg_fill[s]
                 reg_fill[s] += 1
@@ -493,6 +558,10 @@ class RateLimitEngine:
         COMPACT_MAX_* ranges — compact dispatch is permanently disabled to
         keep the saturation guard sound (see ops/kernel.py format note).
         """
+        if self.multiprocess:
+            raise NotImplementedError(
+                "stacked dispatch in mesh mode lands with the lockstep "
+                "window clock integration")
         if not compact_safe:
             self._compact_enabled = False
         self.state, fused, self.gstate, self.gcfg = self._multi_fn(
@@ -528,19 +597,66 @@ class RateLimitEngine:
                np.zeros((Kg,), np.int32))
         return gbatch, gacc, upd, ups
 
-    def warmup(self) -> None:
-        """Compile and execute one empty window per serving executable (full
-        and compact) so serving never pays the jit.
+    def register_global_keys(self, specs: Sequence[tuple],
+                             now: Optional[int] = None) -> None:
+        """Pre-register GLOBAL limits: (key, limit, duration, algorithm).
+
+        In mesh mode this is the ONLY way GLOBAL keys enter the replicated
+        arena: every process must call it at boot with the IDENTICAL ordered
+        list (and the identical `now`), which makes the replicated config
+        writes — the part of GLOBAL traffic that cannot ride the psum —
+        bit-identical on every replica.  Single-process engines may also use
+        it as a config preload; dynamic per-request registration stays
+        available there.
+        """
+        now = self._resolve_now(now)
+        buf = self._buf
+        K = self.max_global_updates
+        for base in range(0, len(specs), K):
+            chunk = specs[base:base + K]
+            buf.reset(self.global_capacity)
+            r = 0
+            for i, (key, limit, duration, algorithm) in enumerate(chunk):
+                slot, is_init = self.gtable.lookup(key, now, duration)
+                buf.uslot[i] = slot
+                buf.ulimit[i] = limit
+                buf.uduration[i] = duration
+                buf.ualgo[i] = algorithm
+                if is_init:
+                    buf.rslot[r] = slot
+                    r += 1
+            self._dispatch(now)
+            self.windows_processed += 1
+
+    def warmup(self, now: Optional[int] = None) -> None:
+        """Compile and execute one empty window per serving executable so
+        serving never pays the jit.  Mesh mode: pass the cluster-agreed
+        timestamp (every process must warm up in lockstep).
 
         (An empty `process()` call is a no-op on the native path, so callers
         that need the compile — cluster boot, daemon start — use this.)"""
+        now = self._resolve_now(now)
         saved = self._compact_enabled
         self._compact_enabled = False
         self._buf.reset(self.global_capacity)
-        self._dispatch(millisecond_now())
+        self._dispatch(now)
         self._compact_enabled = saved
-        self._buf.reset(self.global_capacity)
-        self._dispatch(millisecond_now())
+        if saved:
+            self._buf.reset(self.global_capacity)
+            self._dispatch(now)
+
+    def _resolve_now(self, now: Optional[int]) -> int:
+        """Default `now` to wall clock — except in mesh mode, where the
+        window timestamp is a REPLICATED input: every process must pass the
+        same agreed value (e.g. the lockstep clock's tick time), so a
+        per-host wall-clock default would silently diverge the replicas."""
+        if now is not None:
+            return now
+        if self.multiprocess:
+            raise ValueError(
+                "mesh mode requires an explicit, cluster-agreed `now` "
+                "per window (the lockstep clock provides one)")
+        return millisecond_now()
 
     def _compact_eligible(self, buf) -> bool:
         """May this window travel in the compact wire format?  Vectorized
@@ -567,6 +683,31 @@ class RateLimitEngine:
             and bool((buf.hits < kernel.COMPACT_MAX_HITS).all())
         )
 
+    def _sharded_in(self, local_np):
+        """Local [S_local, ...] staging block -> global [S, ...] array."""
+        if not self.multiprocess:
+            return local_np
+        gshape = (self.num_shards,) + local_np.shape[1:]
+        return jax.make_array_from_process_local_data(
+            self._shard_sharding, local_np, gshape)
+
+    def _repl_in(self, arr):
+        """Replicated input: every process MUST pass identical values."""
+        if not self.multiprocess:
+            return arr
+        arr = np.asarray(arr)
+        return jax.make_array_from_process_local_data(
+            self._repl_sharding, arr, arr.shape)
+
+    def _fetch_local(self, arr):
+        """device_get of this process's shard blocks, in shard order:
+        [S_local, ...] (the whole array when single-process)."""
+        if not self.multiprocess:
+            return jax.device_get(arr)
+        shards = sorted(arr.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
     def _dispatch(self, now: int):
         """Run the staged buffers through the device step; returns host copies
         of the (regular, global) outputs.
@@ -574,39 +715,54 @@ class RateLimitEngine:
         The transfer is the dominant per-window fixed cost (catastrophically
         so on a tunneled chip; PCIe-bound otherwise), so eligible windows use
         the compact wire format (_compiled_step_compact) and everything else
-        a single fused fetch (_compiled_step)."""
+        a single fused fetch (_compiled_step).
+
+        In mesh mode every process must call this in lockstep (same dispatch
+        sequence), staging its own local lanes; replicated control inputs
+        (upd/ups/now) must be identical everywhere."""
         buf = self._buf
         gbatch = WindowBatch(
-            slot=buf.gslot, hits=buf.ghits, limit=buf.glimit,
-            duration=buf.gduration, algo=buf.galgo, is_init=buf.gis_init,
+            slot=self._sharded_in(buf.gslot), hits=self._sharded_in(buf.ghits),
+            limit=self._sharded_in(buf.glimit),
+            duration=self._sharded_in(buf.gduration),
+            algo=self._sharded_in(buf.galgo),
+            is_init=self._sharded_in(buf.gis_init),
         )
-        upd = (buf.uslot, buf.ulimit, buf.uduration, buf.ualgo, buf.rslot)
-        ups = (buf.pslot, buf.plimit, buf.pduration, buf.premaining,
-               buf.ptstamp, buf.pexpire, buf.palgo)
+        gacc = self._sharded_in(buf.ghits_acc)
+        upd = tuple(self._repl_in(a) for a in (
+            buf.uslot, buf.ulimit, buf.uduration, buf.ualgo, buf.rslot))
+        ups = tuple(self._repl_in(a) for a in (
+            buf.pslot, buf.plimit, buf.pduration, buf.premaining,
+            buf.ptstamp, buf.pexpire, buf.palgo))
+        now_in = self._repl_in(np.int64(now)) if self.multiprocess \
+            else jnp.int64(now)
         if self._compact_eligible(buf):
-            packed = kernel.encode_batch_host(
+            packed = self._sharded_in(kernel.encode_batch_host(
                 buf.slot, buf.hits, buf.limit, buf.duration, buf.algo,
-                buf.is_init)
+                buf.is_init))
             self.state, cword, gfused, self.gstate, self.gcfg = self._compact_fn(
                 self.state, self.gstate, self.gcfg, packed, gbatch,
-                buf.ghits_acc, upd, ups, jnp.int64(now),
+                gacc, upd, ups, now_in,
             )
-            cword, gfused = jax.device_get((cword, gfused))
-            out = kernel.decode_output_host(cword, now)
+            out = kernel.decode_output_host(self._fetch_local(cword), now)
+            gfused = self._fetch_local(gfused)
             gout = WindowOutput(
                 status=gfused[..., 0], limit=gfused[..., 1],
                 remaining=gfused[..., 2], reset_time=gfused[..., 3])
             return out, gout
         batch = WindowBatch(
-            slot=buf.slot, hits=buf.hits, limit=buf.limit,
-            duration=buf.duration, algo=buf.algo, is_init=buf.is_init,
+            slot=self._sharded_in(buf.slot), hits=self._sharded_in(buf.hits),
+            limit=self._sharded_in(buf.limit),
+            duration=self._sharded_in(buf.duration),
+            algo=self._sharded_in(buf.algo),
+            is_init=self._sharded_in(buf.is_init),
         )
         self.state, fused, self.gstate, self.gcfg = self._step_fn(
-            self.state, self.gstate, self.gcfg, batch, gbatch, buf.ghits_acc,
-            upd, ups, jnp.int64(now),
+            self.state, self.gstate, self.gcfg, batch, gbatch, gacc,
+            upd, ups, now_in,
         )
         return kernel.split_outputs(
-            jax.device_get(fused), self.batch_per_shard)
+            self._fetch_local(fused), self.batch_per_shard)
 
     def process(
         self,
@@ -618,37 +774,56 @@ class RateLimitEngine:
         if self.native is not None:
             return self._process_native(requests, now, accumulate)
         S = self.num_shards
+        SL = self.num_local_shards
+        if self.multiprocess:
+            # validate routing BEFORE dispatching anything: a mis-routed key
+            # discovered mid-stream would fail requests whose hits earlier
+            # chunks already committed (double-count on client retry)
+            for r in requests:
+                if r.behavior != Behavior.GLOBAL:
+                    key = r.hash_key()
+                    if not (0 <= shard_of(key, S) - self.local_shard_offset < SL):
+                        raise ValueError(
+                            f"key {key!r} belongs to shard {shard_of(key, S)}, "
+                            "not owned by this process")
         out: List[RateLimitResp] = []
         chunk: List[RateLimitReq] = []
         chunk_acc: List[bool] = []
-        reg_fill = [0] * S
-        glob_fill = [0] * S
+        reg_fill = [0] * SL
+        g_count = 0
         gkeys: set = set()
 
         def flush():
-            nonlocal chunk, chunk_acc, reg_fill, glob_fill, gkeys
+            nonlocal chunk, chunk_acc, reg_fill, g_count, gkeys
             out.extend(self.step(chunk, now, chunk_acc))
             chunk, chunk_acc = [], []
-            reg_fill = [0] * S
-            glob_fill = [0] * S
+            reg_fill = [0] * SL
+            g_count = 0
             gkeys = set()
 
         for i, r in enumerate(requests):
             key = r.hash_key()
-            s = shard_of(key, S)
             g = r.behavior == Behavior.GLOBAL
             new_gkey = 1 if (g and key not in gkeys) else 0
-            over = (
-                (g and glob_fill[s] + 1 > self.global_batch_per_shard)
-                or ((not g) and reg_fill[s] + 1 > self.batch_per_shard)
-                or (len(gkeys) + new_gkey > self.max_global_updates)
-            )
+            if g:
+                # step() spreads GLOBAL lanes round-robin over local shards
+                over = (
+                    g_count + 1 > SL * self.global_batch_per_shard
+                    or len(gkeys) + new_gkey > self.max_global_updates
+                )
+            else:
+                s = shard_of(key, S) - self.local_shard_offset
+                if not 0 <= s < SL:
+                    raise ValueError(
+                        f"key {key!r} belongs to shard {shard_of(key, S)}, "
+                        "not owned by this process")
+                over = reg_fill[s] + 1 > self.batch_per_shard
             if over:
                 flush()
             chunk.append(r)
             chunk_acc.append(accumulate[i] if accumulate is not None else True)
             if g:
-                glob_fill[s] += 1
+                g_count += 1
                 gkeys.add(key)
             else:
                 reg_fill[s] += 1

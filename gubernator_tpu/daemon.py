@@ -85,6 +85,7 @@ class Daemon:
                 prefix=c.etcd_prefix,
                 username=c.etcd_username,
                 password=c.etcd_password,
+                ssl_context=c.etcd_ssl_context(),
             )
             await self.pool.start()
         elif static_peers:

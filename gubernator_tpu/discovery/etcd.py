@@ -60,17 +60,22 @@ class EtcdPool:
         prefix: str = "/gubernator/peers/",
         username: str = "",
         password: str = "",
+        ssl_context=None,
     ):
         if not advertise_address:
             raise ValueError("AdvertiseAddress is required")  # etcd.go:68
         self.base = endpoints[0].rstrip("/")
         if not self.base.startswith("http"):
-            self.base = "http://" + self.base
+            # TLS-configured connections default to the https scheme
+            # (the reference's etcd client switches transports on conf.TLS)
+            scheme = "https://" if ssl_context is not None else "http://"
+            self.base = scheme + self.base
         self.prefix = prefix
         self.advertise_address = advertise_address
         self.on_update = on_update
         self.username = username
         self.password = password
+        self.ssl_context = ssl_context
         self._session: Optional[aiohttp.ClientSession] = None
         self._lease_id: Optional[int] = None
         self._peers: Dict[str, PeerInfo] = {}
@@ -82,16 +87,22 @@ class EtcdPool:
             r.raise_for_status()
             return await r.json()
 
+    def _connector(self) -> Optional[aiohttp.TCPConnector]:
+        if self.ssl_context is None:
+            return None
+        return aiohttp.TCPConnector(ssl=self.ssl_context)
+
     async def start(self) -> None:
         headers = {}
         if self.username:
             # v3 JSON gateway auth: exchange user/pass for a token
-            async with aiohttp.ClientSession() as s:
+            async with aiohttp.ClientSession(connector=self._connector()) as s:
                 async with s.post(self.base + "/v3/auth/authenticate", json={
                     "name": self.username, "password": self.password}) as r:
                     r.raise_for_status()
                     headers["Authorization"] = (await r.json())["token"]
-        self._session = aiohttp.ClientSession(headers=headers)
+        self._session = aiohttp.ClientSession(
+            headers=headers, connector=self._connector())
         await self._register()
         await self._collect()
         self._tasks.append(asyncio.create_task(self._keepalive_loop()))

@@ -1,0 +1,70 @@
+"""Multi-host mesh mode: one SPMD device mesh spanning daemon processes.
+
+The reference scales out as N independent nodes exchanging gRPC
+(peers.go:130-172).  This framework supports that same topology ("node
+mode": every daemon owns its chips and its slice of the keyspace, peer plane
+over gRPC — see net/peers.py), and additionally a TPU-native topology this
+module enables:
+
+  MESH MODE — all hosts join one `jax.sharding.Mesh` via
+  `jax.distributed.initialize`; the bucket arena is one global array sharded
+  over every chip of every host; each host packs request lanes for its local
+  shards and all hosts dispatch the SAME compiled window step in lockstep.
+  Cross-shard traffic inside the mesh needs no RPCs at all, and the GLOBAL
+  reconciliation psum rides ICI within a slice / DCN across slices — the
+  collective replaces the reference's async-hits + broadcast gRPC dance
+  entirely (global.go:72-232).
+
+Lockstep is a hard requirement: every process must issue the same sequence
+of engine dispatches (the collectives inside the step otherwise deadlock).
+The serving layer guarantees this by flushing windows on a fixed clock
+(tick even when empty) rather than on demand.
+
+Env surface (daemon wiring):
+  GUBER_MESH_COORDINATOR   host:port of process 0 (enables mesh mode)
+  GUBER_MESH_NUM_PROCESSES total process count
+  GUBER_MESH_PROCESS_ID    this process's rank
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from gubernator_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+
+
+def initialize_from_env() -> bool:
+    """Join the distributed runtime if GUBER_MESH_COORDINATOR is set.
+
+    Returns True when mesh mode is active.  Must run before any other JAX
+    call in the process (jax.distributed.initialize constraint)."""
+    coord = os.environ.get("GUBER_MESH_COORDINATOR", "")
+    if not coord:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(os.environ["GUBER_MESH_NUM_PROCESSES"]),
+        process_id=int(os.environ["GUBER_MESH_PROCESS_ID"]),
+    )
+    return True
+
+
+def global_mesh():
+    """The mesh over every device of every process (shard axis)."""
+    return make_mesh(jax.devices())
+
+
+def local_device_indices(mesh) -> list[int]:
+    """Flat mesh-device indices owned by this process (its shard ids)."""
+    devs = mesh.devices.reshape(-1)
+    return [i for i, d in enumerate(devs)
+            if d.process_index == jax.process_index()]
+
+
+def owning_process(shard: int, mesh) -> int:
+    """Which process owns a global shard index (for host-side routing)."""
+    return int(mesh.devices.reshape(-1)[shard].process_index)

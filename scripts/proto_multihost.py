@@ -1,0 +1,81 @@
+"""Prototype: 2-process global mesh, engine-style shard_map step with psum.
+
+Each process owns 4 virtual CPU devices (shards). Both dispatch one window in
+lockstep; process-local input blocks are assembled into global arrays with
+make_array_from_process_local_data; outputs are read back from addressable
+shards only. Verifies the psum total is identical on both hosts.
+
+Run: python scripts/proto_multihost.py  (parent spawns 2 children)
+     python scripts/proto_multihost.py CHILD <pid>  (internal)
+"""
+
+import os
+import subprocess
+import sys
+
+PORT = 17891
+
+
+def child(pid: int):
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{PORT}",
+        num_processes=2,
+        process_id=pid,
+    )
+    import numpy as np
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    assert len(devs) == 8, devs
+    mesh = Mesh(np.asarray(devs), ("shard",))
+    shard_sharding = NamedSharding(mesh, P("shard"))
+
+    S, B = 8, 16
+
+    def step(hits):
+        def fn(h):
+            local = h[0].sum()
+            return lax.psum(local, "shard")[None]
+
+        return jax.shard_map(fn, mesh=mesh, in_specs=P("shard"),
+                             out_specs=P("shard"))(hits)
+
+    # each process provides its local [4, B] block
+    local = np.full((4, B), pid + 1, np.int32)
+    ghits = jax.make_array_from_process_local_data(shard_sharding, local, (S, B))
+    out = jax.jit(step)(ghits)
+    local_out = [np.asarray(s.data) for s in out.addressable_shards]
+    total = int(local_out[0][0])
+    expect = 4 * B * 1 + 4 * B * 2
+    print(f"child {pid}: psum total = {total} (expect {expect})", flush=True)
+    assert total == expect
+    print(f"child {pid}: OK", flush=True)
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "CHILD":
+        child(int(sys.argv[2]))
+        return
+    procs = [
+        subprocess.Popen(
+            [sys.executable, __file__, "CHILD", str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)
+    ]
+    ok = True
+    for i, p in enumerate(procs):
+        out, _ = p.communicate(timeout=180)
+        print(f"--- child {i} (rc={p.returncode}) ---")
+        print(out[-2000:])
+        ok = ok and p.returncode == 0
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -176,3 +176,76 @@ def test_k8s_endpoints_parsing():
         await pool.close()
 
     asyncio.new_event_loop().run_until_complete(body())
+
+
+def test_etcd_pool_over_tls(tmp_path):
+    """EtcdPool speaks TLS when given the config-built ssl context (the
+    reference's GUBER_ETCD_TLS_* surface, cmd/gubernator/config.go:149-192)."""
+    import os
+    import subprocess
+
+    from gubernator_tpu.config import config_from_env
+
+    cert = tmp_path / "etcd.crt"
+    key = tmp_path / "etcd.key"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+
+    async def body():
+        import ssl
+
+        fake = FakeEtcd()
+        runner = web.AppRunner(fake.app)
+        await runner.setup()
+        srv_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        srv_ctx.load_cert_chain(str(cert), str(key))
+        site = web.TCPSite(runner, "127.0.0.1", 0, ssl_context=srv_ctx)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+
+        env = {"GUBER_ETCD_ENDPOINTS": f"127.0.0.1:{port}",
+               "GUBER_ETCD_TLS_CA": str(cert)}
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            conf = config_from_env()
+        finally:
+            for k, v in old.items():
+                os.environ.pop(k, None) if v is None else os.environ.update({k: v})
+        assert conf.etcd_tls_enabled and not conf.etcd_tls_skip_verify
+
+        updates = []
+
+        async def on_update(peers):
+            updates.append(sorted(p.address for p in peers))
+
+        pool = EtcdPool(
+            endpoints=conf.etcd_addresses,
+            advertise_address="10.0.0.9:81",
+            on_update=on_update,
+            ssl_context=conf.etcd_ssl_context(),
+        )
+        assert pool.base.startswith("https://")
+        await pool.start()
+        assert updates[-1] == ["10.0.0.9:81"]
+        await pool.close()
+        await runner.cleanup()
+
+    asyncio.new_event_loop().run_until_complete(body())
+
+
+def test_etcd_tls_skip_verify_context():
+    import ssl
+
+    from gubernator_tpu.config import DaemonConfig
+
+    c = DaemonConfig()
+    assert c.etcd_ssl_context() is None
+    c.etcd_tls_enabled = True
+    c.etcd_tls_skip_verify = True
+    ctx = c.etcd_ssl_context()
+    assert ctx.verify_mode == ssl.CERT_NONE and not ctx.check_hostname

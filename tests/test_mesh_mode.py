@@ -1,0 +1,141 @@
+"""Mesh mode: one engine arena sharded across two real processes.
+
+Spawns two children that join a jax.distributed runtime (4 virtual CPU
+devices each -> one 8-shard global mesh) and drive the SAME RateLimitEngine
+in lockstep:
+
+  * regular keys: each host serves the shards it owns; token-bucket
+    progression is exact;
+  * GLOBAL keys: pre-registered identically at boot, hits contributed on
+    BOTH hosts reconcile through the in-mesh psum — each host observes the
+    cluster-wide total with no gRPC exchanged (the reference needs the
+    async-hits + broadcast dance for this, global.go:72-232).
+
+The child body lives in this file (run as a script); the pytest wrapper
+spawns it twice and checks both exit codes.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+T0 = 1_700_000_000_000
+
+
+def _child(pid: int, port: int) -> None:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["GUBER_MESH_COORDINATOR"] = f"127.0.0.1:{port}"
+    os.environ["GUBER_MESH_NUM_PROCESSES"] = "2"
+    os.environ["GUBER_MESH_PROCESS_ID"] = str(pid)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from gubernator_tpu.parallel.distributed import (
+        global_mesh,
+        initialize_from_env,
+        owning_process,
+    )
+
+    assert initialize_from_env()
+    assert jax.process_count() == 2 and jax.device_count() == 8
+
+    from gubernator_tpu.api.types import (
+        Algorithm,
+        Behavior,
+        RateLimitReq,
+        Status,
+    )
+    from gubernator_tpu.core.engine import RateLimitEngine, shard_of
+
+    mesh = global_mesh()
+    eng = RateLimitEngine(
+        mesh=mesh,
+        capacity_per_shard=64,
+        batch_per_shard=16,
+        global_capacity=16,
+        global_batch_per_shard=8,
+        max_global_updates=8,
+        use_native=False,
+    )
+    assert eng.multiprocess and eng.num_shards == 8
+    assert eng.num_local_shards == 4
+    assert eng.local_shard_offset == pid * 4
+
+    # ---- boot: identical GLOBAL registration on both processes (lockstep)
+    eng.register_global_keys([("gm_global_g", 100, 60_000,
+                               Algorithm.TOKEN_BUCKET)], now=T0)
+
+    # ---- regular keys: find keys owned by each process
+    mine = []
+    for i in range(200):
+        key = f"gm_reg_{i}"
+        if owning_process(shard_of("mesh_" + key, 8), mesh) == pid:
+            mine.append(RateLimitReq(name="mesh", unique_key=key, hits=1,
+                                     limit=2, duration=60_000))
+        if len(mine) == 3:
+            break
+    assert len(mine) == 3
+
+    # three lockstep windows of local traffic: UNDER, UNDER, OVER
+    expect = [(1, Status.UNDER_LIMIT), (0, Status.UNDER_LIMIT),
+              (0, Status.OVER_LIMIT)]
+    for w, (remaining, status) in enumerate(expect):
+        resps = eng.step(mine, now=T0 + w)
+        for r in resps:
+            assert (r.remaining, r.status) == (remaining, status), \
+                f"window {w}: {r}"
+
+    # ---- GLOBAL psum across processes: one hit contributed on EACH host
+    g = RateLimitReq(name="gm_global", unique_key="g", hits=1, limit=100,
+                     duration=60_000, behavior=Behavior.GLOBAL)
+    r = eng.step([g], now=T0 + 10)[0]
+    assert r.limit == 100  # replica answer (bootstrap read)
+    # next lockstep window: read back — psum applied 2 hits cluster-wide
+    read = RateLimitReq(name="gm_global", unique_key="g", hits=0, limit=100,
+                        duration=60_000, behavior=Behavior.GLOBAL)
+    r = eng.step([read], now=T0 + 11)[0]
+    assert r.remaining == 98, f"expected cluster-wide total 98, got {r}"
+
+    # routing guard: a remote key is rejected, not silently misplaced
+    other = next(f"gm_reg_{i}" for i in range(200)
+                 if owning_process(shard_of(f"mesh_gm_reg_{i}", 8), mesh) != pid)
+    try:
+        eng.step([RateLimitReq(name="mesh", unique_key=other, hits=1, limit=2,
+                               duration=60_000)], now=T0 + 12)
+    except ValueError as e:
+        assert "not owned by this process" in str(e)
+    else:
+        raise AssertionError("remote key accepted")
+
+    print(f"child {pid}: OK", flush=True)
+
+
+def test_two_process_mesh():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, __file__, "CHILD", str(i), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            env=env)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"child {i} failed:\n{out[-4000:]}"
+        assert f"child {i}: OK" in out
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "CHILD":
+        _child(int(sys.argv[2]), int(sys.argv[3]))
