@@ -32,6 +32,7 @@ class WindowBatcher:
         engine: RateLimitEngine,
         behaviors: Optional[BehaviorConfig] = None,
         metrics=None,
+        lockstep_clock=None,
     ):
         self.engine = engine
         self.behaviors = behaviors or BehaviorConfig()
@@ -43,6 +44,91 @@ class WindowBatcher:
         self._executor = ThreadPoolExecutor(max_workers=1,
                                             thread_name_prefix="guber-device")
         self._closed = False
+        # Mesh mode: windows dispatch on a fixed cluster-wide clock — every
+        # tick, even empty, because all processes must issue the same
+        # dispatch sequence (parallel/distributed.py).  submit_now loses its
+        # jump-the-window property; everything rides the next tick.
+        self.clock = lockstep_clock
+        self._tick_task: Optional[asyncio.Task] = None
+        # Graceful lockstep drain: every process agrees on a final tick index
+        # and stops after dispatching exactly that many windows, so no host
+        # is left waiting on a collective that will never be issued.
+        self.stop_at_tick: Optional[int] = None
+
+    def start_lockstep(self) -> None:
+        """Begin the lockstep tick loop (mesh mode; call inside the loop)."""
+        assert self.clock is not None
+        if self._tick_task is None:
+            self._tick_task = asyncio.create_task(self._tick_loop())
+
+    async def _tick_loop(self) -> None:
+        import time as _time
+
+        period = self.behaviors.batch_wait
+        t0 = _time.monotonic()
+        n = 0
+        while not self._closed:
+            if (self.stop_at_tick is not None
+                    and self.clock.tick >= self.stop_at_tick):
+                return
+            n += 1
+            delay = t0 + n * period - _time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            try:
+                window = self._take_window()
+            except Exception:  # defensive: the tick loop must never die
+                window = []
+            await self._run_lockstep_window(window)
+
+    def _take_window(self) -> List[tuple]:
+        """Pull one window's worth of valid pending requests.
+
+        Invalid entries (mis-routed key, unregistered GLOBAL key — e.g. from
+        a peer with a stale picker) are failed INDIVIDUALLY here: a packing
+        exception later would skip this host's dispatch for the tick and
+        wedge the mesh lockstep."""
+        if not self._pending:
+            return []
+        ok = []
+        for item in self._pending:
+            err = self.engine.routing_error(item[0])
+            if err is None:
+                ok.append(item)
+            elif not item[2].done():
+                item[2].set_exception(ValueError(err))
+        fit = self.engine.max_window_prefix([w[0] for w in ok])
+        window, self._pending = ok[:fit], ok[fit:]
+        return window
+
+    async def _run_lockstep_window(self, window: List[tuple]) -> None:
+        reqs = [w[0] for w in window]
+        accumulate = [w[1] for w in window]
+        now = self.clock.next_now()
+        loop = asyncio.get_running_loop()
+        start = time.monotonic()
+        try:
+            resps = await loop.run_in_executor(
+                self._executor,
+                lambda: self.engine.step(reqs, now, accumulate))
+        except Exception as e:
+            for _, _, fut in window:
+                if not fut.done():
+                    fut.set_exception(e)
+            # the tick MUST still issue its collective: every other process
+            # dispatches one this tick (packing errors raise before any
+            # device work, so nothing was dispatched yet)
+            if window:
+                await loop.run_in_executor(
+                    self._executor, lambda: self.engine.step([], now))
+            return
+        if self.metrics is not None and window:
+            self.metrics.window_count.inc()
+            self.metrics.window_occupancy.observe(len(reqs))
+            self.metrics.window_duration.observe(time.monotonic() - start)
+        for (_, _, fut), resp in zip(window, resps):
+            if not fut.done():
+                fut.set_result(resp)
 
     # ------------------------------------------------------------- batched
 
@@ -50,6 +136,8 @@ class WindowBatcher:
         """Queue into the current window; resolves when the window executes."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending.append((req, accumulate, fut))
+        if self.clock is not None:
+            return await fut  # the tick loop drains on the cluster cadence
         if len(self._pending) >= self.behaviors.batch_limit:
             self._flush()
         elif len(self._pending) == 1:
@@ -100,9 +188,17 @@ class WindowBatcher:
         accumulate: Optional[Sequence[bool]] = None,
     ) -> List[RateLimitResp]:
         """Run a ready-made window immediately (NO_BATCHING fast path, and
-        batches arriving from peers that were already aggregated remotely)."""
+        batches arriving from peers that were already aggregated remotely).
+
+        In lockstep (mesh) mode there is no immediate path — the requests
+        join the queue and ride the next cluster tick."""
         loop = asyncio.get_running_loop()
-        acc = list(accumulate) if accumulate is not None else None
+        acc = list(accumulate) if accumulate is not None else [True] * len(reqs)
+        if self.clock is not None:
+            futs = [loop.create_future() for _ in reqs]
+            self._pending.extend(
+                (r, a, f) for r, a, f in zip(reqs, acc, futs))
+            return list(await asyncio.gather(*futs))
         return await loop.run_in_executor(
             self._executor, lambda: self.engine.process(reqs, None, acc)
         )
@@ -121,4 +217,6 @@ class WindowBatcher:
         self._closed = True
         if self._interval is not None:
             self._interval.stop()
+        if self._tick_task is not None:
+            self._tick_task.cancel()
         self._executor.shutdown(wait=False)

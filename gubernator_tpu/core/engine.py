@@ -612,6 +612,11 @@ class RateLimitEngine:
         now = self._resolve_now(now)
         buf = self._buf
         K = self.max_global_updates
+        # last-wins dedupe BEFORE staging: duplicate keys would put duplicate
+        # indices in one device scatter, whose ordering XLA does not define
+        deduped = {key: (key, limit, duration, algorithm)
+                   for key, limit, duration, algorithm in specs}
+        specs = list(deduped.values())
         for base in range(0, len(specs), K):
             chunk = specs[base:base + K]
             buf.reset(self.global_capacity)
@@ -787,49 +792,58 @@ class RateLimitEngine:
                             f"key {key!r} belongs to shard {shard_of(key, S)}, "
                             "not owned by this process")
         out: List[RateLimitResp] = []
-        chunk: List[RateLimitReq] = []
-        chunk_acc: List[bool] = []
+        acc = list(accumulate) if accumulate is not None else [True] * len(requests)
+        pos = 0
+        while pos < len(requests):
+            n = self.max_window_prefix(requests[pos:])
+            out.extend(self.step(requests[pos:pos + n], now, acc[pos:pos + n]))
+            pos += n
+        return out
+
+    def routing_error(self, r: RateLimitReq) -> Optional[str]:
+        """Why this request cannot be served by THIS engine, or None.
+
+        Used by the lockstep batcher to fail bad requests individually
+        instead of letting a packing exception skip a mesh tick."""
+        key = r.hash_key()
+        if r.behavior == Behavior.GLOBAL:
+            if not self._dynamic_global and key not in self.gtable:
+                return (f"GLOBAL key {key!r} is not registered; mesh mode "
+                        "requires register_global_keys at boot")
+            return None
+        s = shard_of(key, self.num_shards)
+        if not 0 <= s - self.local_shard_offset < self.num_local_shards:
+            return (f"key {key!r} belongs to shard {s}, "
+                    "not owned by this process")
+        return None
+
+    def max_window_prefix(self, requests: Sequence[RateLimitReq]) -> int:
+        """How many leading requests fit in ONE step() window (>=1 when any
+        are given).  Shared by process() chunking and the lockstep batcher's
+        per-tick window assembly."""
+        S, SL = self.num_shards, self.num_local_shards
         reg_fill = [0] * SL
         g_count = 0
         gkeys: set = set()
-
-        def flush():
-            nonlocal chunk, chunk_acc, reg_fill, g_count, gkeys
-            out.extend(self.step(chunk, now, chunk_acc))
-            chunk, chunk_acc = [], []
-            reg_fill = [0] * SL
-            g_count = 0
-            gkeys = set()
-
         for i, r in enumerate(requests):
             key = r.hash_key()
-            g = r.behavior == Behavior.GLOBAL
-            new_gkey = 1 if (g and key not in gkeys) else 0
-            if g:
-                # step() spreads GLOBAL lanes round-robin over local shards
-                over = (
-                    g_count + 1 > SL * self.global_batch_per_shard
-                    or len(gkeys) + new_gkey > self.max_global_updates
-                )
+            if r.behavior == Behavior.GLOBAL:
+                new_gkey = 0 if key in gkeys else 1
+                if (g_count + 1 > SL * self.global_batch_per_shard
+                        or len(gkeys) + new_gkey > self.max_global_updates):
+                    return max(i, 1)
+                g_count += 1
+                gkeys.add(key)
             else:
                 s = shard_of(key, S) - self.local_shard_offset
                 if not 0 <= s < SL:
                     raise ValueError(
                         f"key {key!r} belongs to shard {shard_of(key, S)}, "
                         "not owned by this process")
-                over = reg_fill[s] + 1 > self.batch_per_shard
-            if over:
-                flush()
-            chunk.append(r)
-            chunk_acc.append(accumulate[i] if accumulate is not None else True)
-            if g:
-                g_count += 1
-                gkeys.add(key)
-            else:
+                if reg_fill[s] + 1 > self.batch_per_shard:
+                    return max(i, 1)
                 reg_fill[s] += 1
-        if chunk:
-            flush()
-        return out
+        return len(requests)
 
     # ---------------------------------------------------------------- metrics
 

@@ -32,6 +32,7 @@ from gubernator_tpu.core.batcher import WindowBatcher
 from gubernator_tpu.core.engine import RateLimitEngine
 from gubernator_tpu.core.global_sync import GlobalManager
 from gubernator_tpu.net.peers import PeerClient
+from gubernator_tpu.parallel.router import MeshShardPicker
 from gubernator_tpu.observability.metrics import Metrics
 from gubernator_tpu.parallel.router import ConsistentHashRing
 
@@ -52,7 +53,12 @@ class Instance:
         mesh=None,
         engine: Optional[RateLimitEngine] = None,
         metrics: Optional[Metrics] = None,
+        mesh_peers: Optional[List[str]] = None,
     ):
+        """mesh_peers: gRPC addresses of every mesh process in PROCESS-RANK
+        order — enables mesh serving mode (parallel/distributed.py): shard-
+        exact routing, lockstep window clock, GLOBAL via in-mesh psum (the
+        gRPC GlobalManager dance is not used)."""
         self.conf = config or Config()
         self.conf.behaviors.validate()
         self.metrics = metrics or Metrics()
@@ -66,10 +72,26 @@ class Instance:
             max_global_updates=e.max_global_updates,
         )
         self.metrics.watch_engine(self.engine)
-        self.batcher = WindowBatcher(self.engine, self.conf.behaviors, self.metrics)
+        self.mesh_mode = mesh_peers is not None
+        clock = None
+        if self.mesh_mode:
+            from gubernator_tpu.parallel.distributed import (
+                LockstepClock,
+                agree_epoch_ms,
+            )
+
+            clock = LockstepClock(agree_epoch_ms(self.engine.mesh),
+                                  self.conf.behaviors.batch_wait)
+        self.batcher = WindowBatcher(self.engine, self.conf.behaviors,
+                                     self.metrics, lockstep_clock=clock)
         self.global_mgr = GlobalManager(
             self.conf.behaviors, self, self.metrics, log)
-        self._picker: ConsistentHashRing[PeerClient] = ConsistentHashRing()
+        if self.mesh_mode:
+            self._picker = MeshShardPicker.for_mesh(self.engine.mesh,
+                                                    mesh_peers)
+        else:
+            self._picker: ConsistentHashRing[PeerClient] = ConsistentHashRing()
+        self.mesh_peers = list(mesh_peers) if mesh_peers else None
         self.health = HealthCheckResp(status=HEALTHY, peer_count=0)
         self.advertise_address = self.conf.advertise_address
 
@@ -113,6 +135,9 @@ class Instance:
                     error=f"while applying rate limit for '{key}' - '{e}'")
 
         if r.behavior == Behavior.GLOBAL:
+            if self.mesh_mode:
+                # every mesh replica is authoritative after the window psum
+                return await self.batcher.submit(r)
             return await self._global_nonowner(r)
 
         try:
@@ -127,7 +152,8 @@ class Instance:
     async def _local(self, r: RateLimitReq) -> RateLimitResp:
         """Owner-side decision through the device engine (the reference's
         getRateLimit under the cache mutex, gubernator.go:236-251)."""
-        if r.behavior == Behavior.GLOBAL and self._picker.size() > 0:
+        if (r.behavior == Behavior.GLOBAL and self._picker.size() > 0
+                and not self.mesh_mode):
             # owner saw a GLOBAL change: schedule an authoritative broadcast
             # (gubernator.go:240-242)
             self.global_mgr.queue_update(r)
@@ -219,7 +245,10 @@ class Instance:
             message="|".join(errs),
             peer_count=picker.size(),
         )
-        self.global_mgr.start()
+        if not self.mesh_mode:
+            # mesh mode replicates GLOBAL state through the in-mesh psum;
+            # the gRPC async-hits/broadcast loops stay off
+            self.global_mgr.start()
         log.info("Peers updated: %s", [p.address for p in peers])
         for client in departed:
             if client is not None:

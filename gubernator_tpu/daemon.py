@@ -52,21 +52,73 @@ class Daemon:
     async def start(self) -> None:
         c = self.conf
         apply_platform_env()
+
+        # Mesh mode: join the jax.distributed runtime BEFORE any device use;
+        # the arena then shards over every process's chips and all hosts
+        # dispatch windows on the lockstep clock (parallel/distributed.py).
+        import os
+        from gubernator_tpu.parallel.distributed import initialize_from_env
+        mesh = None
+        mesh_peers = None
+        if initialize_from_env():
+            from gubernator_tpu.parallel.distributed import global_mesh
+            mesh = global_mesh()
+            peers_env = os.environ.get("GUBER_MESH_PEERS", "")
+            mesh_peers = [a.strip() for a in peers_env.split(",") if a.strip()]
+            if not mesh_peers:
+                raise ValueError(
+                    "mesh mode requires GUBER_MESH_PEERS (gRPC addresses in "
+                    "process-rank order)")
+            import jax
+            if len(mesh_peers) != jax.process_count():
+                raise ValueError(
+                    f"GUBER_MESH_PEERS lists {len(mesh_peers)} addresses but "
+                    f"the mesh has {jax.process_count()} processes — the "
+                    "list must name every process, in rank order")
+            log.info("mesh mode: %d processes, %d global shards",
+                     len(mesh_peers), mesh.devices.size)
+
         self.instance = Instance(Config(
             behaviors=c.behaviors,
             engine=c.engine,
             advertise_address=c.advertise_address,
-        ))
-        # compile the device step before accepting traffic
-        self.instance.engine.warmup()
+        ), mesh=mesh, mesh_peers=mesh_peers)
+        # compile the device step before accepting traffic; mesh mode needs a
+        # cluster-agreed timestamp (all processes warm up in lockstep)
+        if mesh_peers is not None:
+            eng = self.instance.engine
+            eng.warmup(now=self.instance.batcher.clock.epoch_ms)
+            gk_file = os.environ.get("GUBER_GLOBAL_KEYS_FILE", "")
+            if gk_file:
+                import json
+                with open(gk_file) as f:
+                    specs = [(d["key"], d["limit"], d["duration"],
+                              d.get("algorithm", 0))
+                             for d in (json.loads(ln) for ln in f
+                                       if ln.strip())]
+                eng.register_global_keys(
+                    specs, now=self.instance.batcher.clock.epoch_ms)
+                log.info("registered %d GLOBAL keys", len(specs))
+        else:
+            self.instance.engine.warmup()
 
         self.grpc = GrpcServer(self.instance, c.grpc_listen_address)
         await self.grpc.start()
         log.info("gRPC listening on %s", self.grpc.address)
 
-        import os
         static_peers = os.environ.get("GUBER_STATIC_PEERS", "")
-        if c.k8s_enabled:
+        if mesh_peers is not None:
+            # mesh membership is fixed by process rank; discovery backends
+            # don't apply (elasticity = re-forming the mesh)
+            from gubernator_tpu.discovery.static import StaticPool
+            self.pool = StaticPool(
+                addresses=mesh_peers,
+                advertise_address=c.advertise_address,
+                on_update=self.instance.set_peers,
+            )
+            await self.pool.start()
+            self.instance.batcher.start_lockstep()
+        elif c.k8s_enabled:
             from gubernator_tpu.discovery.kubernetes import K8sPool
             self.pool = K8sPool(
                 namespace=c.k8s_namespace,

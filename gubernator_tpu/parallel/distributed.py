@@ -68,3 +68,54 @@ def local_device_indices(mesh) -> list[int]:
 def owning_process(shard: int, mesh) -> int:
     """Which process owns a global shard index (for host-side routing)."""
     return int(mesh.devices.reshape(-1)[shard].process_index)
+
+
+def agree_epoch_ms(mesh) -> int:
+    """Every process learns process 0's wall clock via one tiny collective.
+
+    The lockstep window clock derives each tick's timestamp from this agreed
+    epoch, because the window `now` is a replicated step input that must be
+    bit-identical on every process (engine._resolve_now)."""
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from gubernator_tpu.api.types import millisecond_now
+
+    local = np.full(
+        (len(local_device_indices(mesh)),),
+        millisecond_now() if jax.process_index() == 0 else 0,
+        np.int64,
+    )
+    sh = NamedSharding(mesh, P(SHARD_AXIS))
+    gv = jax.make_array_from_process_local_data(sh, local,
+                                                (mesh.devices.size,))
+
+    def fn(v):
+        first = lax.axis_index(SHARD_AXIS) == 0
+        return lax.psum(jnp.where(first, v[0], jnp.int64(0)), SHARD_AXIS)[None]
+
+    out = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=P(SHARD_AXIS),
+                                out_specs=P(SHARD_AXIS)))(gv)
+    return int(np.asarray(out.addressable_shards[0].data)[0])
+
+
+class LockstepClock:
+    """Deterministic per-tick timestamps shared by every mesh process.
+
+    Tick i's window timestamp is epoch + i*interval — identical everywhere
+    by construction.  Hosts pace ticks with their local clocks; the
+    collectives inside each window act as the rendezvous, so skew shows up
+    as backpressure, never as divergent state."""
+
+    def __init__(self, epoch_ms: int, interval_s: float):
+        self.epoch_ms = epoch_ms
+        self.interval_s = interval_s
+        self.tick = 0
+
+    def next_now(self) -> int:
+        # rounded per tick from the exact float interval, so logical time
+        # never drifts from wall time even for sub-millisecond ticks
+        now = self.epoch_ms + round(self.tick * self.interval_s * 1000)
+        self.tick += 1
+        return now
