@@ -215,15 +215,13 @@ class RateLimitEngine:
         # the per-key Python dict path.  The two backends are exclusive —
         # regular-key routing state lives in exactly one of them.
         self.native = None
-        if self.multiprocess:
-            # the C router hashes keys straight to global shard lanes; its
-            # local-shard remapping is wired up in a later round
-            if use_native not in ("auto", False, "off"):
-                raise RuntimeError("native router not yet supported in mesh mode")
-        elif use_native in ("auto", True, "on"):
+        if use_native in ("auto", True, "on"):
             from gubernator_tpu import native as native_mod
             if native_mod.available():
-                self.native = native_mod.NativeRouter(S, C)
+                self.native = native_mod.NativeRouter(
+                    self.num_local_shards, C,
+                    num_global_shards=S,
+                    shard_offset=self.local_shard_offset)
             elif use_native != "auto":
                 raise RuntimeError("native router requested but unavailable")
 
@@ -394,8 +392,10 @@ class RateLimitEngine:
         keys and upserts are rare control-plane traffic and keep the Python
         gtable path, packed into the same device dispatch.
         """
-        if now is None:
-            now = millisecond_now()
+        now = self._resolve_now(now)
+        if upserts and not self._dynamic_global:
+            raise ValueError("upserts are not supported in mesh mode "
+                             "(GLOBAL state replicates via the in-mesh psum)")
         S = self.num_shards
         B = self.batch_per_shard
         buf = self._buf
@@ -429,7 +429,7 @@ class RateLimitEngine:
             c_algo = np.asarray(ralgo, dtype=np.int32)
             out_shard = np.zeros(nreg, np.int32)
             out_lane = np.zeros(nreg, np.int32)
-        shard_fill = np.zeros(S, np.int32)
+        shard_fill = np.zeros(self.num_local_shards, np.int32)
 
         pending_upserts = list(upserts) if upserts else []
         pos = 0
@@ -463,27 +463,41 @@ class RateLimitEngine:
                     buf.is_init.view(np.uint8),
                     out_shard[pos:], out_lane[pos:], shard_fill,
                 )
+                # mesh mode: the C router marks keys hashing to remote
+                # shards; reject BEFORE dispatch (no hits committed)
+                bad = out_shard[pos:pos + packed] < 0
+                if bad.any():
+                    r_bad = requests[reg_idx[pos + int(np.argmax(bad))]]
+                    raise ValueError(
+                        f"key {r_bad.hash_key()!r} belongs to shard "
+                        f"{shard_of(r_bad.hash_key(), S)}, not owned by "
+                        "this process")
 
-            # global lanes (python table), bounded by caps
+            # global lanes (python table), bounded by caps; spread
+            # round-robin over LOCAL shards (the psum is shard-agnostic)
             glanes: List[tuple] = []
-            glob_fill = [0] * S
+            g_count = 0
             gcfg_upd = {}
             greset: List[int] = []
             while gpos + len(glanes) < len(glob):
                 i, r, contribute = glob[gpos + len(glanes)]
                 key = r.hash_key()
-                s = shard_of(key, S)
-                if glob_fill[s] + 1 > self.global_batch_per_shard:
+                if not self._dynamic_global and key not in self.gtable:
+                    raise ValueError(
+                        f"GLOBAL key {key!r} is not registered; mesh mode "
+                        "requires register_global_keys at boot")
+                if g_count + 1 > self.num_local_shards * self.global_batch_per_shard:
                     break
                 if len(gcfg_upd) + 1 > self.max_global_updates:
                     break
                 slot, is_init = self.gtable.lookup(key, now, r.duration)
-                if contribute:
+                if contribute and self._dynamic_global:
                     gcfg_upd[slot] = (r.limit, r.duration, r.algorithm)
                     if is_init:
                         greset.append(slot)
-                lane = glob_fill[s]
-                glob_fill[s] += 1
+                s = g_count % self.num_local_shards
+                lane = g_count // self.num_local_shards
+                g_count += 1
                 buf.gslot[s, lane] = slot
                 buf.ghits[s, lane] = r.hits
                 buf.ghits_acc[s, lane] = r.hits if contribute else 0

@@ -52,6 +52,8 @@ def _load() -> Optional[ctypes.CDLL]:
         u8p = ctypes.POINTER(ctypes.c_uint8)
         lib.router_new.restype = ctypes.c_void_p
         lib.router_new.argtypes = [ctypes.c_int32, ctypes.c_int32]
+        lib.router_new_mesh.restype = ctypes.c_void_p
+        lib.router_new_mesh.argtypes = [ctypes.c_int32] * 4
         lib.router_free.argtypes = [ctypes.c_void_p]
         lib.router_pack.restype = ctypes.c_int64
         lib.router_pack.argtypes = [
@@ -77,12 +79,19 @@ def _ptr(a: np.ndarray, ctype):
 class NativeRouter:
     """Batch key→(shard, slot) resolution + window packing in one C call."""
 
-    def __init__(self, num_shards: int, capacity_per_shard: int):
+    def __init__(self, num_shards: int, capacity_per_shard: int,
+                 num_global_shards: int = None, shard_offset: int = 0):
+        """num_shards = LOCAL shards staged by this process; in mesh mode
+        keys hash over num_global_shards and mis-routed keys come back
+        marked out_shard == -1 (reject before dispatching)."""
         lib = _load()
         if lib is None:
             raise RuntimeError("native router library unavailable")
         self._lib = lib
-        self._handle = lib.router_new(num_shards, capacity_per_shard)
+        if num_global_shards is None:
+            num_global_shards = num_shards
+        self._handle = lib.router_new_mesh(
+            num_global_shards, shard_offset, num_shards, capacity_per_shard)
         self.num_shards = num_shards
         self.capacity_per_shard = capacity_per_shard
 

@@ -87,7 +87,9 @@ struct Shard {
 
 struct Router {
   Shard* shards;
-  int32_t num_shards;
+  int32_t num_shards;         // local shards staged by this process
+  int32_t num_global_shards;  // hashing modulus (== num_shards single-proc)
+  int32_t shard_offset;       // first local shard's global index
 };
 
 uint32_t next_pow2(uint32_t v) {
@@ -195,14 +197,25 @@ int32_t shard_lookup(Shard* s, uint64_t fp, int64_t now, int64_t duration,
 
 extern "C" {
 
-Router* router_new(int32_t num_shards, int32_t capacity_per_shard) {
+// Mesh mode (parallel/distributed.py): keys hash over num_global_shards but
+// this process only stages lanes for [shard_offset, shard_offset+num_shards).
+// Single-process: global == local, offset 0 (router_new).
+Router* router_new_mesh(int32_t num_global_shards, int32_t shard_offset,
+                        int32_t num_local_shards,
+                        int32_t capacity_per_shard) {
   crc32_init();
   Router* r = (Router*)malloc(sizeof(Router));
-  r->num_shards = num_shards;
-  r->shards = (Shard*)malloc(sizeof(Shard) * num_shards);
-  for (int32_t i = 0; i < num_shards; i++)
+  r->num_shards = num_local_shards;
+  r->num_global_shards = num_global_shards;
+  r->shard_offset = shard_offset;
+  r->shards = (Shard*)malloc(sizeof(Shard) * num_local_shards);
+  for (int32_t i = 0; i < num_local_shards; i++)
     shard_init(&r->shards[i], capacity_per_shard);
   return r;
+}
+
+Router* router_new(int32_t num_shards, int32_t capacity_per_shard) {
+  return router_new_mesh(num_shards, 0, num_shards, capacity_per_shard);
 }
 
 void router_free(Router* r) {
@@ -233,13 +246,23 @@ int64_t router_pack(
     int64_t beg = i == 0 ? 0 : key_ends[i - 1];
     int64_t len = key_ends[i] - beg;
     const uint8_t* key = key_bytes + beg;
-    uint32_t shard = crc32(key, len) % (uint32_t)r->num_shards;
+    int32_t shard =
+        (int32_t)(crc32(key, len) % (uint32_t)r->num_global_shards) -
+        r->shard_offset;
+    if (shard < 0 || shard >= r->num_shards) {
+      // mis-routed key (mesh mode): mark it and let the caller reject the
+      // batch before dispatching — it consumes no lane
+      out_shard[i] = -1;
+      out_lane[i] = -1;
+      continue;
+    }
     int32_t lane = shard_fill[shard];
     if (lane >= lanes) return i;
     uint8_t is_init = 0;
     int32_t slot = shard_lookup(&r->shards[shard], fnv1a64(key, len), now,
                                 durations[i], &is_init);
     int64_t o = (int64_t)shard * lanes + lane;
+
     out_slot[o] = slot;
     out_hits[o] = hits[i];
     out_limit[o] = limits[i];
