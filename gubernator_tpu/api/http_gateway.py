@@ -16,6 +16,8 @@ over TCP), this calls the Instance in-process.
 
 from __future__ import annotations
 
+import time
+
 from aiohttp import web
 from google.protobuf import json_format
 
@@ -25,23 +27,43 @@ from gubernator_tpu.observability.metrics import CONTENT_TYPE_LATEST
 
 
 def build_app(instance: Instance) -> web.Application:
+    # The reference's gateway dials its own gRPC port, so gateway traffic
+    # flows through the gRPC stats handler and is counted per-RPC
+    # (prometheus.go:104-137).  This gateway is in-process, so the handlers
+    # observe the same metric names themselves.
     async def get_rate_limits(request: web.Request) -> web.Response:
+        m = instance.metrics
+        start = time.monotonic()
+        ok = False
         try:
-            body = await request.text()
-            msg = json_format.Parse(body, pb.GetRateLimitsReq())
-        except json_format.ParseError as e:
-            return web.json_response({"error": str(e), "code": 3}, status=400)
-        try:
-            resps = await instance.get_rate_limits(
-                [pb.req_from_pb(r) for r in msg.requests])
-        except BatchTooLargeError as e:
-            return web.json_response({"error": str(e), "code": 11}, status=400)
-        out = pb.GetRateLimitsResp(responses=[pb.resp_to_pb(r) for r in resps])
-        return web.json_response(
-            json_format.MessageToDict(out, preserving_proto_field_name=False))
+            try:
+                body = await request.text()
+                msg = json_format.Parse(body, pb.GetRateLimitsReq())
+            except json_format.ParseError as e:
+                return web.json_response({"error": str(e), "code": 3},
+                                         status=400)
+            try:
+                resps = await instance.get_rate_limits(
+                    [pb.req_from_pb(r) for r in msg.requests])
+            except BatchTooLargeError as e:
+                return web.json_response({"error": str(e), "code": 11},
+                                         status=400)
+            ok = True
+            out = pb.GetRateLimitsResp(
+                responses=[pb.resp_to_pb(r) for r in resps])
+            return web.json_response(
+                json_format.MessageToDict(out,
+                                          preserving_proto_field_name=False))
+        finally:
+            # every RPC is observed, including unexpected 500s — during an
+            # incident the failure rate must show up in the counters
+            m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=ok)
 
     async def health_check(request: web.Request) -> web.Response:
+        start = time.monotonic()
         h = await instance.health_check()
+        instance.metrics.observe_rpc(
+            "/pb.gubernator.V1/HealthCheck", start, ok=True)
         msg = pb.HealthCheckResp(
             status=h.status, message=h.message, peer_count=h.peer_count)
         return web.json_response(

@@ -50,6 +50,9 @@ class WindowBatcher:
         # jump-the-window property; everything rides the next tick.
         self.clock = lockstep_clock
         self._tick_task: Optional[asyncio.Task] = None
+        # set when this host can no longer keep its collective sequence
+        # aligned (repeated dispatch failure): fail-stop, don't diverge
+        self._failed = False
         # Graceful lockstep drain: every process agrees on a final tick index
         # and stops after dispatching exactly that many windows, so no host
         # is left waiting on a collective that will never be issued.
@@ -79,7 +82,20 @@ class WindowBatcher:
                 window = self._take_window()
             except Exception:  # defensive: the tick loop must never die
                 window = []
-            await self._run_lockstep_window(window)
+            try:
+                await self._run_lockstep_window(window)
+            except Exception:
+                # dispatch irrecoverably failed (see the fail-stop in
+                # _run_lockstep_window): stop ticking and fail everything
+                # still queued instead of silently desyncing the mesh
+                self._failed = True
+                for _, _, fut in self._pending:
+                    if not fut.done():
+                        fut.set_exception(
+                            RuntimeError("lockstep dispatch failed; "
+                                         "this host left the mesh"))
+                self._pending.clear()
+                raise
 
     def _take_window(self) -> List[tuple]:
         """Pull one window's worth of valid pending requests.
@@ -107,6 +123,11 @@ class WindowBatcher:
         now = self.clock.next_now()
         loop = asyncio.get_running_loop()
         start = time.monotonic()
+        # Structural invariant: this tick issues EXACTLY one device dispatch,
+        # no matter what step() does.  windows_processed increments once per
+        # dispatch, so compare it instead of guessing whether step() raised
+        # before or after its device work.
+        before = self.engine.windows_processed
         try:
             resps = await loop.run_in_executor(
                 self._executor,
@@ -115,12 +136,27 @@ class WindowBatcher:
             for _, _, fut in window:
                 if not fut.done():
                     fut.set_exception(e)
-            # the tick MUST still issue its collective: every other process
-            # dispatches one this tick (packing errors raise before any
-            # device work, so nothing was dispatched yet)
-            if window:
-                await loop.run_in_executor(
-                    self._executor, lambda: self.engine.step([], now))
+            if self.engine.windows_processed == before:
+                # step() raised before any device work: issue the tick's
+                # collective so the other processes' dispatches pair up
+                # (an empty step() dispatches exactly once on both backends).
+                # Retry transient failures — skipping the dispatch entirely
+                # would desync this host's collective sequence permanently,
+                # which is worse than blocking the tick (the other hosts just
+                # wait in the collective, which is ordinary backpressure).
+                for attempt in range(3):
+                    try:
+                        await loop.run_in_executor(
+                            self._executor,
+                            lambda: self.engine.step([], now))
+                        break
+                    except Exception:
+                        if attempt == 2:
+                            # fail-stop beats silent divergence: a host that
+                            # cannot dispatch can never rejoin the lockstep
+                            self._failed = True
+                            raise
+                        await asyncio.sleep(0.05)
             return
         if self.metrics is not None and window:
             self.metrics.window_count.inc()
@@ -135,6 +171,9 @@ class WindowBatcher:
     async def submit(self, req: RateLimitReq, accumulate: bool = True) -> RateLimitResp:
         """Queue into the current window; resolves when the window executes."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        if self._failed:
+            raise RuntimeError("lockstep dispatch failed; "
+                               "this host left the mesh")
         self._pending.append((req, accumulate, fut))
         if self.clock is not None:
             return await fut  # the tick loop drains on the cluster cadence
@@ -198,7 +237,14 @@ class WindowBatcher:
             futs = [loop.create_future() for _ in reqs]
             self._pending.extend(
                 (r, a, f) for r, a, f in zip(reqs, acc, futs))
-            return list(await asyncio.gather(*futs))
+            # Per-item error semantics (the reference returns item-level
+            # errors inside the batch response, gubernator.go:218-226): one
+            # invalid request — e.g. mis-routed by a peer's stale picker and
+            # failed individually by _take_window — must not discard the
+            # responses of valid requests whose hits this tick committed.
+            results = await asyncio.gather(*futs, return_exceptions=True)
+            return [r if isinstance(r, RateLimitResp)
+                    else RateLimitResp(error=str(r)) for r in results]
         return await loop.run_in_executor(
             self._executor, lambda: self.engine.process(reqs, None, acc)
         )

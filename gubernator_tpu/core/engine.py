@@ -401,6 +401,24 @@ class RateLimitEngine:
         buf = self._buf
         responses: List[Optional[RateLimitResp]] = [None] * len(requests)
 
+        single_chunk_cap = min(
+            self.batch_per_shard,
+            self.num_local_shards * self.global_batch_per_shard)
+        if self.multiprocess and len(requests) > single_chunk_cap:
+            # The call may need multiple chunks (worst case: every key lands
+            # on one shard), so validate EVERY request's routing before the
+            # first dispatch — a mis-routed key discovered in a later chunk
+            # would raise after earlier chunks already committed hits
+            # (double-count on client retry).  Windows that provably fit one
+            # chunk skip this: the C router marks bad keys and the GLOBAL
+            # loop checks registration BEFORE that chunk's (only) dispatch,
+            # so the lockstep hot path — pre-validated by _take_window —
+            # pays no second hashing pass.
+            for r in requests:
+                err = self.routing_error(r)
+                if err is not None:
+                    raise ValueError(err)
+
         # split into regular (columnar) and global (listed) requests
         reg_idx: List[int] = []
         keys_b: List[bytes] = []
@@ -434,7 +452,14 @@ class RateLimitEngine:
         pending_upserts = list(upserts) if upserts else []
         pos = 0
         gpos = 0
-        while pos < nreg or gpos < len(glob) or pending_upserts:
+        # Dispatch parity with the Python path: step() always issues exactly
+        # one device dispatch per call — including for an EMPTY window.  In
+        # mesh mode every process must issue an identical dispatch sequence
+        # per lockstep tick (core/batcher.py), so a zero-dispatch empty tick
+        # on one host would wedge the collectives cluster-wide.
+        first = True
+        while first or pos < nreg or gpos < len(glob) or pending_upserts:
+            first = False
             buf.reset(self.global_capacity)
             shard_fill[:] = 0
 
@@ -512,7 +537,8 @@ class RateLimitEngine:
             for j, slot in enumerate(greset):
                 buf.rslot[j] = slot
 
-            if packed == 0 and not glanes and not ups_chunk:
+            if (packed == 0 and not glanes and not ups_chunk
+                    and (pos < nreg or gpos < len(glob))):
                 raise RuntimeError("window packing made no progress")
 
             out, gout = self._dispatch(now)
@@ -553,6 +579,7 @@ class RateLimitEngine:
         ups,
         nows,
         compact_safe: bool = False,
+        n_decisions: Optional[int] = None,
     ) -> jax.Array:
         """Apply K stacked windows in one device dispatch (see
         _compiled_multi_step).  All arguments carry a leading K dimension
@@ -584,8 +611,13 @@ class RateLimitEngine:
         )
         k = int(batches.slot.shape[0])
         self.windows_processed += k
-        lanes = int(np.prod(batches.slot.shape[1:]))
-        self.decisions_processed += k * lanes
+        if n_decisions is None:
+            # lane-capacity fallback: the stacked inputs may be resident
+            # device arrays, so the real request count (slot != PAD_SLOT) is
+            # not host-visible here — callers with partially-filled stacks
+            # should pass n_decisions to keep the throughput counter honest
+            n_decisions = k * int(np.prod(batches.slot.shape[1:]))
+        self.decisions_processed += n_decisions
         return fused
 
     def empty_control(self):

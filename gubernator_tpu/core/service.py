@@ -136,9 +136,20 @@ class Instance:
 
         if r.behavior == Behavior.GLOBAL:
             if self.mesh_mode:
-                # every mesh replica is authoritative after the window psum
-                return await self.batcher.submit(r)
-            return await self._global_nonowner(r)
+                try:
+                    # every mesh replica is authoritative after the window psum
+                    return await self.batcher.submit(r)
+                except Exception as e:
+                    # per-item failure (e.g. unregistered GLOBAL key failed
+                    # individually by _take_window) must not abort the whole
+                    # client batch via the gather in get_rate_limits
+                    return RateLimitResp(
+                        error=f"while applying rate limit for '{key}' - '{e}'")
+            try:
+                return await self._global_nonowner(r)
+            except Exception as e:
+                return RateLimitResp(
+                    error=f"while applying rate limit for '{key}' - '{e}'")
 
         try:
             resp = await peer.get_peer_rate_limit(r)
@@ -203,7 +214,14 @@ class Instance:
     async def read_global_status(self, probe: RateLimitReq) -> RateLimitResp:
         """Authoritative hits=0 read used by the broadcast loop
         (global.go:199-203)."""
-        return (await self.batcher.submit_now([probe]))[0]
+        resp = (await self.batcher.submit_now([probe]))[0]
+        if resp.error:
+            # the broadcast loop must SKIP this key, not push a zeroed
+            # status to every replica as authoritative (submit_now reports
+            # per-item failures in-band, so surface them as an exception
+            # here where a failure means "don't broadcast")
+            raise RuntimeError(resp.error)
+        return resp
 
     async def health_check(self) -> HealthCheckResp:
         return self.health

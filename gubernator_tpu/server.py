@@ -35,7 +35,12 @@ class _V1Servicer:
         return pb.GetRateLimitsResp(responses=[pb.resp_to_pb(r) for r in resps])
 
     async def HealthCheck(self, request, context):
+        # the reference's stats-handler observes EVERY RPC, HealthCheck
+        # included (prometheus.go:104-137)
+        start = time.monotonic()
         h = await self.instance.health_check()
+        self.instance.metrics.observe_rpc(
+            "/pb.gubernator.V1/HealthCheck", start, ok=True)
         return pb.HealthCheckResp(
             status=h.status, message=h.message, peer_count=h.peer_count)
 
@@ -59,6 +64,7 @@ class _PeersServicer:
 
     async def UpdatePeerGlobals(self, request, context):
         from gubernator_tpu.api.types import UpdatePeerGlobal
+        start = time.monotonic()
         ups = [
             UpdatePeerGlobal(
                 key=g.key,
@@ -69,6 +75,8 @@ class _PeersServicer:
             for g in request.globals
         ]
         await self.instance.update_peer_globals(ups)
+        self.instance.metrics.observe_rpc(
+            "/pb.gubernator.PeersV1/UpdatePeerGlobals", start, ok=True)
         return pb.UpdatePeerGlobalsResp()
 
 

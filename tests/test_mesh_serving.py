@@ -203,7 +203,7 @@ def test_mesh_serving_two_nodes():
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=300)
+            out, _ = p.communicate(timeout=120)
         except subprocess.TimeoutExpired:
             p.kill()
             out, _ = p.communicate()
