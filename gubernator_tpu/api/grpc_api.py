@@ -18,12 +18,17 @@ PEERS_SERVICE = "pb.gubernator.PeersV1"
 
 
 def add_v1_servicer(server: grpc.aio.Server, servicer) -> None:
-    """servicer: async methods GetRateLimits(req, ctx), HealthCheck(req, ctx)."""
+    """servicer: async methods GetRateLimits(req, ctx), HealthCheck(req, ctx).
+
+    GetRateLimits is registered at the BYTES level (no grpc-layer proto
+    codec): the servicer owns decode/encode so eligible RPCs can run the
+    native fast path (core/fastpath.py) without ever materializing Python
+    protobuf objects."""
     handlers = {
         "GetRateLimits": grpc.unary_unary_rpc_method_handler(
             servicer.GetRateLimits,
-            request_deserializer=pb.GetRateLimitsReq.FromString,
-            response_serializer=pb.GetRateLimitsResp.SerializeToString,
+            request_deserializer=None,
+            response_serializer=None,
         ),
         "HealthCheck": grpc.unary_unary_rpc_method_handler(
             servicer.HealthCheck,

@@ -264,6 +264,11 @@ class RateLimitEngine:
         S = self.num_shards
         buf = self._buf
         buf.reset(self.global_capacity)
+        # init-pending protocol (state/arena.py): fresh allocations keep
+        # reporting is_init until the dispatch below commits this window
+        for t in self.tables:
+            t.begin_window()
+        self.gtable.begin_window()
 
         if upserts and not self._dynamic_global:
             # gRPC-broadcast upserts are host-local writes; in mesh mode they
@@ -360,6 +365,9 @@ class RateLimitEngine:
             buf.rslot[i] = slot
 
         out, gout = self._dispatch(now)
+        for t in self.tables:
+            t.commit_window()
+        self.gtable.commit_window()
 
         self.windows_processed += 1
         self.decisions_processed += len(requests)
@@ -462,6 +470,7 @@ class RateLimitEngine:
             first = False
             buf.reset(self.global_capacity)
             shard_fill[:] = 0
+            self.gtable.begin_window()
 
             ups_chunk = pending_upserts[: self.max_global_updates]
             pending_upserts = pending_upserts[self.max_global_updates:]
@@ -542,6 +551,8 @@ class RateLimitEngine:
                 raise RuntimeError("window packing made no progress")
 
             out, gout = self._dispatch(now)
+            self.native.commit()
+            self.gtable.commit_window()
             if packed:
                 # vectorized demux: one fancy-indexed gather per field, then
                 # plain-python scalars (per-item numpy indexing is ~10x slower)
@@ -666,6 +677,7 @@ class RateLimitEngine:
         for base in range(0, len(specs), K):
             chunk = specs[base:base + K]
             buf.reset(self.global_capacity)
+            self.gtable.begin_window()
             r = 0
             for i, (key, limit, duration, algorithm) in enumerate(chunk):
                 slot, is_init = self.gtable.lookup(key, now, duration)
@@ -677,6 +689,7 @@ class RateLimitEngine:
                     buf.rslot[r] = slot
                     r += 1
             self._dispatch(now)
+            self.gtable.commit_window()
             self.windows_processed += 1
 
     def warmup(self, now: Optional[int] = None) -> None:

@@ -1,0 +1,97 @@
+"""Native fast serving path: request bytes -> device window -> response bytes.
+
+The slow path per RPC is: grpc deserializes GetRateLimitsReq (Python
+protobuf), per-item dataclass conversion, per-item validation + routing,
+window packing, dispatch, per-item response dataclasses, protobuf encode.
+At saturation that Python work — not the device — bounds decisions/sec.
+
+Here the whole host side of an eligible RPC is two C calls around one
+device dispatch (native/host_router.cc fastpath_parse/fastpath_encode):
+
+  bytes in ──C: parse+route+slot-allocate+stage compact lanes──►
+      one compact-format device dispatch (engine._compact_fn) ──►
+  ◄──C: decode compact response + serialize GetRateLimitsResp── bytes out
+
+Eligibility (checked per RPC; anything else falls back to the full path,
+which handles every semantic):
+  * native router active, single-process engine, compact format still sound
+    (engine._compact_enabled — the saturation guard, see ops/kernel.py);
+  * standalone instance (no peer ring): every key is served locally
+    (reference analog: a single-node deployment of gubernator.go:75-166);
+  * every request is BATCHING, valid, and within compact ranges (the C
+    parser enforces this and reports a fallback code otherwise).
+
+The reference has no equivalent component — its Go codegen decode is "free"
+relative to Python's; this module is what makes the Python serving plane
+competitive with it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from gubernator_tpu.config import MAX_BATCH_SIZE
+
+
+class FastPath:
+    """Per-instance fast-path state (staging buffers + constant device inputs).
+
+    handle() must run on the engine executor thread (the single device
+    stream) — the WindowBatcher provides that serialization.
+    """
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.enabled = engine.native is not None and not engine.multiprocess
+        if not self.enabled:
+            return
+        import jax
+
+        SL = engine.num_local_shards
+        B = engine.batch_per_shard
+        self.lanes = B
+        self.packed = np.zeros((SL, B, 2), np.int64)
+        self.out_shard = np.empty(MAX_BATCH_SIZE, np.int32)
+        self.out_lane = np.empty(MAX_BATCH_SIZE, np.int32)
+        self.shard_fill = np.zeros(SL, np.int32)
+        # worst-case response: ~50B/item (4 full varint fields + header)
+        self.resp_buf = np.empty(MAX_BATCH_SIZE * 64 + 64, np.uint8)
+        # constant empty GLOBAL staging, resident on device once
+        gbatch, gacc, upd, ups = engine.empty_control()
+        self._gbatch = jax.device_put(gbatch)
+        self._gacc = jax.device_put(gacc)
+        self._upd = jax.device_put(upd)
+        self._ups = jax.device_put(ups)
+
+    def handle(self, data: bytes, now: int) -> Optional[bytes]:
+        """Serve one GetRateLimitsReq wholly natively; None => use the full
+        path (never partially commits: any fallback happens before the
+        dispatch)."""
+        eng = self.engine
+        if not self.enabled or not eng._compact_enabled:
+            return None
+        self.packed.fill(0)
+        self.shard_fill.fill(0)
+        n = eng.native.fastpath_parse(
+            data, now, self.lanes, MAX_BATCH_SIZE, self.packed,
+            self.out_shard, self.out_lane, self.shard_fill)
+        if n < 0:
+            return None
+        import jax
+
+        eng.state, cword, _gfused, eng.gstate, eng.gcfg = eng._compact_fn(
+            eng.state, eng.gstate, eng.gcfg, self.packed, self._gbatch,
+            self._gacc, self._upd, self._ups, now,
+        )
+        eng.native.commit()  # dispatch issued: fresh slots are initialized
+        cw = jax.device_get(cword)
+        if not cw.flags["C_CONTIGUOUS"]:
+            cw = np.ascontiguousarray(cw)
+        m = eng.native.fastpath_encode(
+            cw, now, self.lanes, n, self.out_shard, self.out_lane,
+            self.resp_buf)
+        eng.windows_processed += 1
+        eng.decisions_processed += n
+        return bytes(self.resp_buf[:m])

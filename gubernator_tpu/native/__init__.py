@@ -64,6 +64,18 @@ def _load() -> Optional[ctypes.CDLL]:
         for fn in ("router_size", "router_hits", "router_misses"):
             getattr(lib, fn).restype = ctypes.c_int64
             getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.router_commit.restype = None
+        lib.router_commit.argtypes = [ctypes.c_void_p]
+        lib.fastpath_parse.restype = ctypes.c_int64
+        lib.fastpath_parse.argtypes = [
+            ctypes.c_void_p, u8p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int64, i64p, i32p, i32p, i32p,
+        ]
+        lib.fastpath_encode.restype = ctypes.c_int64
+        lib.fastpath_encode.argtypes = [
+            i64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
+            i32p, i32p, u8p, ctypes.c_int64,
+        ]
         _lib = lib
         return _lib
 
@@ -129,6 +141,42 @@ class NativeRouter:
             _ptr(out_shard, ctypes.c_int32), _ptr(out_lane, ctypes.c_int32),
             _ptr(shard_fill, ctypes.c_int32),
         )
+
+    def commit(self) -> None:
+        """Confirm the window staged by the last pack/parse was dispatched
+        (clears its entries' init-pending flags)."""
+        self._lib.router_commit(self._handle)
+
+    def fastpath_parse(self, data: bytes, now: int, lanes: int,
+                       max_items: int, packed: np.ndarray,
+                       out_shard: np.ndarray, out_lane: np.ndarray,
+                       shard_fill: np.ndarray) -> int:
+        """Serialized GetRateLimitsReq -> staged compact window.
+
+        Returns n >= 0 (requests staged) or a negative fallback code (the
+        caller must then run the full Python path); see host_router.cc."""
+        # zero-copy read-only view of the immutable bytes
+        buf = ctypes.cast(ctypes.c_char_p(data),
+                          ctypes.POINTER(ctypes.c_uint8))
+        return self._lib.fastpath_parse(
+            self._handle, buf, len(data), now, lanes, max_items,
+            _ptr(packed, ctypes.c_int64), _ptr(out_shard, ctypes.c_int32),
+            _ptr(out_lane, ctypes.c_int32), _ptr(shard_fill, ctypes.c_int32),
+        )
+
+    def fastpath_encode(self, cword: np.ndarray, now: int, lanes: int,
+                        n: int, out_shard: np.ndarray, out_lane: np.ndarray,
+                        resp_buf: np.ndarray) -> int:
+        """Fetched compact response -> serialized GetRateLimitsResp bytes
+        (returns the length written into resp_buf)."""
+        m = self._lib.fastpath_encode(
+            _ptr(cword, ctypes.c_int64), now, lanes, n,
+            _ptr(out_shard, ctypes.c_int32), _ptr(out_lane, ctypes.c_int32),
+            _ptr(resp_buf, ctypes.c_uint8), resp_buf.nbytes,
+        )
+        if m < 0:
+            raise RuntimeError("fastpath_encode: response buffer too small")
+        return m
 
     @property
     def size(self) -> int:

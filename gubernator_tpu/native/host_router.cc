@@ -15,9 +15,11 @@
 //     (n^2 / 2^65), and a collision merely merges two keys' counters.
 //   * shard = crc32(key) % num_shards, matching the Python router
 //     (core/engine.py shard_of) so native and Python paths route alike.
-//   * strict LRU per shard via an intrusive doubly-linked list over entry
-//     indices; eviction pops the tail exactly like the reference
-//     (cache/lru.go:92-94,131-136).
+//   * per-shard LRU via an intrusive doubly-linked list over entry indices.
+//     A full shard first reclaims an EXPIRED slot (lazy expiry min-heap)
+//     and only then evicts the LRU tail like the reference
+//     (cache/lru.go:92-94,131-136) — so churny workloads never evict live
+//     keys while dead ones occupy slots.
 //   * expiry estimates refresh on every touch; hit/miss counters match the
 //     reference's semantics (expired-entry touch counts as a miss,
 //     lru.go:110-119).
@@ -67,6 +69,11 @@ uint64_t fnv1a64(const uint8_t* data, int64_t len) {
 
 constexpr int32_t NIL = -1;
 
+struct HeapNode {
+  int64_t expire;
+  int32_t e;
+};
+
 struct Shard {
   // open-addressing table: cell -> entry index (or NIL)
   int32_t* cells;
@@ -83,6 +90,19 @@ struct Shard {
   int32_t free_top;
   int32_t capacity;
   int64_t hits, misses, size;
+  // init-pending tracking: a freshly (re)allocated entry keeps reporting
+  // is_init=1 until a device dispatch actually commits its window
+  // (router_commit).  Without this, a pack that aborts before dispatch
+  // would consume the flag, and a retry could inherit a recycled slot's
+  // previous tenant's live device state.
+  uint8_t* pending;
+  uint32_t* seq;  // pack sequence that last reported is_init for the entry
+  // lazy expiry min-heap: lets a full shard reclaim an EXPIRED slot before
+  // evicting a live LRU victim.  Nodes go stale when an entry is re-touched
+  // (its expiry moved) or evicted; staleness is detected on pop by
+  // comparing against the entry's live expire + residency.
+  HeapNode* heap;
+  int64_t heap_len, heap_cap;
 };
 
 struct Router {
@@ -90,6 +110,9 @@ struct Router {
   int32_t num_shards;         // local shards staged by this process
   int32_t num_global_shards;  // hashing modulus (== num_shards single-proc)
   int32_t shard_offset;       // first local shard's global index
+  uint32_t pack_seq;          // increments per pack/parse call
+  int64_t* commit_list;       // (shard << 32) | entry, pending inits staged
+  int64_t commit_len, commit_cap;  //   by the LAST pack/parse call
 };
 
 uint32_t next_pow2(uint32_t v) {
@@ -114,6 +137,71 @@ void shard_init(Shard* s, int32_t capacity) {
   s->lru_head = s->lru_tail = NIL;
   s->capacity = capacity;
   s->hits = s->misses = s->size = 0;
+  s->pending = (uint8_t*)calloc(capacity, sizeof(uint8_t));
+  s->seq = (uint32_t*)calloc(capacity, sizeof(uint32_t));
+  s->heap = nullptr;
+  s->heap_len = s->heap_cap = 0;
+}
+
+// entry e is resident iff some table cell still points at it (cell_of is
+// only maintained while resident, and removal clears the pointing cell)
+inline bool is_resident(Shard* s, int32_t e) {
+  return s->cells[s->cell_of[e]] == e;
+}
+
+void heap_sift_down(Shard* s, int64_t i) {
+  HeapNode v = s->heap[i];
+  for (;;) {
+    int64_t l = 2 * i + 1, r = l + 1, m = i;
+    int64_t best = v.expire;
+    if (l < s->heap_len && s->heap[l].expire < best) {
+      m = l;
+      best = s->heap[l].expire;
+    }
+    if (r < s->heap_len && s->heap[r].expire < best) m = r;
+    if (m == i) break;
+    s->heap[i] = s->heap[m];
+    i = m;
+  }
+  s->heap[i] = v;
+}
+
+void heap_push(Shard* s, int64_t expire, int32_t e) {
+  if (s->heap_len == s->heap_cap) {
+    if (s->heap_len > 4 * (int64_t)s->capacity) {
+      // mostly stale: rebuild from the resident entries (walk the LRU list)
+      s->heap_len = 0;
+      for (int32_t i = s->lru_head; i != NIL; i = s->next[i]) {
+        s->heap[s->heap_len].expire = s->expire[i];
+        s->heap[s->heap_len].e = i;
+        s->heap_len++;
+      }
+      for (int64_t i = s->heap_len / 2 - 1; i >= 0; i--) heap_sift_down(s, i);
+    }
+    if (s->heap_len == s->heap_cap) {
+      s->heap_cap = s->heap_cap ? s->heap_cap * 2 : 1024;
+      s->heap = (HeapNode*)realloc(s->heap, sizeof(HeapNode) * s->heap_cap);
+    }
+  }
+  int64_t i = s->heap_len++;
+  while (i > 0) {
+    int64_t p = (i - 1) / 2;
+    if (s->heap[p].expire <= expire) break;
+    s->heap[i] = s->heap[p];
+    i = p;
+  }
+  s->heap[i].expire = expire;
+  s->heap[i].e = e;
+}
+
+
+void push_commit(Router* r, int32_t shard, int32_t e) {
+  if (r->commit_len == r->commit_cap) {
+    r->commit_cap = r->commit_cap ? r->commit_cap * 2 : 256;
+    r->commit_list = (int64_t*)realloc(r->commit_list,
+                                       sizeof(int64_t) * r->commit_cap);
+  }
+  r->commit_list[r->commit_len++] = ((int64_t)shard << 32) | (uint32_t)e;
 }
 
 void lru_unlink(Shard* s, int32_t e) {
@@ -152,9 +240,30 @@ void table_delete_cell(Shard* s, uint32_t cell) {
   s->cells[hole] = NIL;
 }
 
-// returns slot; *is_init set when the key was (re)allocated
+// pop expired entries until one is live-and-truly-expired; returns its
+// entry index (removed from table+LRU, ready for reuse) or NIL
+int32_t try_reclaim_expired(Shard* s, int64_t now) {
+  while (s->heap_len > 0 && s->heap[0].expire < now) {
+    HeapNode n = s->heap[0];
+    s->heap[0] = s->heap[--s->heap_len];
+    if (s->heap_len) heap_sift_down(s, 0);
+    int32_t e = n.e;
+    if (is_resident(s, e) && s->expire[e] == n.expire) {
+      lru_unlink(s, e);
+      table_delete_cell(s, s->cell_of[e]);
+      return e;
+    }
+  }
+  return NIL;
+}
+
+// returns slot; *is_init set when the device must (re)initialize it.
+// cur_seq: the current pack call's sequence — a pending entry reports
+// is_init only once per pack call (later duplicates in the same window see
+// the in-window live register, kernel-side), but keeps reporting it across
+// pack calls until router_commit confirms a dispatch wrote the slot.
 int32_t shard_lookup(Shard* s, uint64_t fp, int64_t now, int64_t duration,
-                     uint8_t* is_init) {
+                     uint32_t cur_seq, uint8_t* is_init) {
   uint32_t cell = (uint32_t)(fp & s->mask);
   for (;;) {
     int32_t e = s->cells[cell];
@@ -162,24 +271,36 @@ int32_t shard_lookup(Shard* s, uint64_t fp, int64_t now, int64_t duration,
     if (s->fp[e] == fp) {
       if (s->expire[e] < now) s->misses++;  // expired touch counts as a miss
       else s->hits++;
-      s->expire[e] = now + duration;
+      if (s->expire[e] != now + duration) {
+        s->expire[e] = now + duration;
+        heap_push(s, now + duration, e);
+      }
       lru_unlink(s, e);
       lru_push_front(s, e);
-      *is_init = 0;
+      if (s->pending[e] && s->seq[e] != cur_seq) {
+        s->seq[e] = cur_seq;
+        *is_init = 1;  // allocated by an earlier pack that never dispatched
+      } else {
+        *is_init = 0;
+      }
       return e;
     }
     cell = (cell + 1) & s->mask;
   }
-  // miss: allocate (free slot, else evict LRU tail)
+  // miss: allocate (free slot, else reclaim an expired slot, else evict
+  // the LRU tail)
   s->misses++;
   int32_t e;
   if (s->free_top > 0) {
     e = s->free_list[--s->free_top];
     s->size++;
   } else {
-    e = s->lru_tail;
-    lru_unlink(s, e);
-    table_delete_cell(s, s->cell_of[e]);
+    e = try_reclaim_expired(s, now);
+    if (e == NIL) {
+      e = s->lru_tail;
+      lru_unlink(s, e);
+      table_delete_cell(s, s->cell_of[e]);
+    }
     // the probe chain may have shifted into our target cell; re-probe
     cell = (uint32_t)(fp & s->mask);
     while (s->cells[cell] != NIL) cell = (cell + 1) & s->mask;
@@ -188,7 +309,10 @@ int32_t shard_lookup(Shard* s, uint64_t fp, int64_t now, int64_t duration,
   s->cell_of[e] = cell;
   s->fp[e] = fp;
   s->expire[e] = now + duration;
+  heap_push(s, now + duration, e);
   lru_push_front(s, e);
+  s->pending[e] = 1;
+  s->seq[e] = cur_seq;
   *is_init = 1;
   return e;
 }
@@ -211,7 +335,21 @@ Router* router_new_mesh(int32_t num_global_shards, int32_t shard_offset,
   r->shards = (Shard*)malloc(sizeof(Shard) * num_local_shards);
   for (int32_t i = 0; i < num_local_shards; i++)
     shard_init(&r->shards[i], capacity_per_shard);
+  r->pack_seq = 0;
+  r->commit_list = nullptr;
+  r->commit_len = r->commit_cap = 0;
   return r;
+}
+
+// Confirm that the window staged by the LAST pack/parse call was actually
+// dispatched: its fresh allocations stop reporting is_init.
+void router_commit(Router* r) {
+  for (int64_t i = 0; i < r->commit_len; i++) {
+    int32_t shard = (int32_t)(r->commit_list[i] >> 32);
+    int32_t e = (int32_t)(r->commit_list[i] & 0xFFFFFFFF);
+    r->shards[shard].pending[e] = 0;
+  }
+  r->commit_len = 0;
 }
 
 Router* router_new(int32_t num_shards, int32_t capacity_per_shard) {
@@ -223,8 +361,10 @@ void router_free(Router* r) {
     Shard* s = &r->shards[i];
     free(s->cells); free(s->fp); free(s->expire); free(s->cell_of);
     free(s->prev); free(s->next); free(s->free_list);
+    free(s->pending); free(s->seq); free(s->heap);
   }
   free(r->shards);
+  free(r->commit_list);
   free(r);
 }
 
@@ -242,6 +382,8 @@ int64_t router_pack(
     int32_t* out_slot, int64_t* out_hits, int64_t* out_limit,
     int64_t* out_duration, int32_t* out_algo, uint8_t* out_is_init,
     int32_t* out_shard, int32_t* out_lane, int32_t* shard_fill) {
+  r->pack_seq++;
+  r->commit_len = 0;  // an uncommitted previous window stays pending
   for (int64_t i = 0; i < n; i++) {
     int64_t beg = i == 0 ? 0 : key_ends[i - 1];
     int64_t len = key_ends[i] - beg;
@@ -260,7 +402,8 @@ int64_t router_pack(
     if (lane >= lanes) return i;
     uint8_t is_init = 0;
     int32_t slot = shard_lookup(&r->shards[shard], fnv1a64(key, len), now,
-                                durations[i], &is_init);
+                                durations[i], r->pack_seq, &is_init);
+    if (is_init) push_commit(r, shard, slot);
     int64_t o = (int64_t)shard * lanes + lane;
 
     out_slot[o] = slot;
@@ -274,6 +417,252 @@ int64_t router_pack(
     shard_fill[shard] = lane + 1;
   }
   return n;
+}
+
+// ---- fast serving path --------------------------------------------------
+//
+// One C call takes a serialized GetRateLimitsReq straight to a staged
+// compact-format device window (api/proto/gubernator.proto; wire format in
+// ops/kernel.py "compact wire format"), and a second C call takes the
+// fetched compact response straight to a serialized GetRateLimitsResp.
+// This replaces the per-item Python protobuf decode + dataclass hops that
+// otherwise bound the serving path (the reference's whole GetRateLimits
+// walk, gubernator.go:75-166, is Go codegen + map ops; ours is two C calls
+// and one device dispatch).
+//
+// The parser is deliberately narrow: BATCHING behavior, valid algorithm,
+// nonempty name/key, compact-range hits/limit/duration.  Anything else
+// returns a negative code and the caller falls back to the full Python
+// path, which handles every semantic (per-item errors, GLOBAL, chunking).
+
+namespace {
+
+inline bool read_varint(const uint8_t** pp, const uint8_t* end,
+                        uint64_t* out) {
+  const uint8_t* p = *pp;
+  uint64_t v = 0;
+  int shift = 0;
+  while (p < end && shift < 70) {
+    uint8_t b = *p++;
+    v |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      *pp = p;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+inline uint32_t crc32_update(uint32_t c, const uint8_t* d, int64_t n) {
+  for (int64_t i = 0; i < n; i++)
+    c = crc32_table[(c ^ d[i]) & 0xFF] ^ (c >> 8);
+  return c;
+}
+
+inline uint64_t fnv1a_update(uint64_t h, const uint8_t* d, int64_t n) {
+  for (int64_t i = 0; i < n; i++) {
+    h ^= d[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+inline int varint_size(uint64_t v) {
+  int n = 1;
+  while (v >= 0x80) {
+    v >>= 7;
+    n++;
+  }
+  return n;
+}
+
+inline uint8_t* write_varint(uint8_t* p, uint64_t v) {
+  while (v >= 0x80) {
+    *p++ = (uint8_t)(v | 0x80);
+    v >>= 7;
+  }
+  *p++ = (uint8_t)v;
+  return p;
+}
+
+constexpr int64_t COMPACT_MAX_HITS = 1ll << 28;
+constexpr int64_t COMPACT_MAX_LIMIT = 1ll << 31;
+constexpr int64_t COMPACT_MAX_DURATION = (1ll << 31) - 16;
+
+}  // namespace
+
+// Parse a serialized GetRateLimitsReq and stage it as a compact-format
+// window.  packed is i64[num_local_shards, lanes, 2], pre-zeroed by the
+// caller (w0 == 0 marks a padded lane).  Returns the request count n >= 0
+// on success, or:
+//   -1  malformed protobuf
+//   -2  a request needs the full path (behavior/algorithm/validation/range)
+//   -3  more than max_items requests
+//   -4  a shard's lanes overflowed (caller chunks via the full path)
+//   -5  a key routed to a shard this process does not own (mesh mode)
+int64_t fastpath_parse(Router* r, const uint8_t* buf, int64_t len,
+                       int64_t now, int32_t lanes, int64_t max_items,
+                       int64_t* packed, int32_t* out_shard,
+                       int32_t* out_lane, int32_t* shard_fill) {
+  r->pack_seq++;
+  r->commit_len = 0;
+  const uint8_t* p = buf;
+  const uint8_t* end = buf + len;
+  int64_t n = 0;
+  while (p < end) {
+    uint64_t tag;
+    if (!read_varint(&p, end, &tag)) return -1;
+    if (tag != ((1u << 3) | 2)) {  // only field 1: repeated RateLimitReq
+      // skip unknown top-level field
+      int wt = (int)(tag & 7);
+      if (wt == 0) {
+        uint64_t dummy;
+        if (!read_varint(&p, end, &dummy)) return -1;
+      } else if (wt == 2) {
+        uint64_t l;
+        if (!read_varint(&p, end, &l) || l > (uint64_t)(end - p))
+          return -1;
+        p += l;
+      } else {
+        return -1;
+      }
+      continue;
+    }
+    uint64_t mlen;
+    if (!read_varint(&p, end, &mlen) || mlen > (uint64_t)(end - p))
+      return -1;
+    const uint8_t* q = p;
+    const uint8_t* qend = p + mlen;
+    p = qend;
+
+    if (n >= max_items) return -3;
+
+    const uint8_t* name = nullptr;
+    int64_t name_len = 0;
+    const uint8_t* key = nullptr;
+    int64_t key_len = 0;
+    int64_t hits = 0, limit = 0, duration = 0;
+    uint64_t algo = 0, behavior = 0;
+    while (q < qend) {
+      uint64_t t;
+      if (!read_varint(&q, qend, &t)) return -1;
+      uint64_t field = t >> 3;
+      int wt = (int)(t & 7);
+      if (wt == 2) {
+        uint64_t l;
+        if (!read_varint(&q, qend, &l) || l > (uint64_t)(qend - q))
+          return -1;
+        if (field == 1) {
+          name = q;
+          name_len = (int64_t)l;
+        } else if (field == 2) {
+          key = q;
+          key_len = (int64_t)l;
+        }
+        q += l;
+      } else if (wt == 0) {
+        uint64_t v;
+        if (!read_varint(&q, qend, &v)) return -1;
+        if (field == 3) hits = (int64_t)v;
+        else if (field == 4) limit = (int64_t)v;
+        else if (field == 5) duration = (int64_t)v;
+        else if (field == 6) algo = v;
+        else if (field == 7) behavior = v;
+      } else {
+        return -1;
+      }
+    }
+
+    if (name_len == 0 || key_len == 0) return -2;  // per-item error path
+    if (behavior != 0) return -2;                  // BATCHING only
+    if (algo > 1) return -2;                       // invalid algorithm
+    if (hits < 0 || hits >= COMPACT_MAX_HITS) return -2;
+    if (limit < 0 || limit >= COMPACT_MAX_LIMIT) return -2;
+    if (duration < 0 || duration >= COMPACT_MAX_DURATION) return -2;
+
+    // hash key = name + "_" + unique_key (client.go:33-35), streamed
+    uint32_t c = 0xFFFFFFFFu;
+    c = crc32_update(c, name, name_len);
+    uint8_t sep = '_';
+    c = crc32_update(c, &sep, 1);
+    c = crc32_update(c, key, key_len);
+    uint32_t crc = c ^ 0xFFFFFFFFu;
+    uint64_t fp = fnv1a_update(1469598103934665603ull, name, name_len);
+    fp = fnv1a_update(fp, &sep, 1);
+    fp = fnv1a_update(fp, key, key_len);
+    if (!fp) fp = 1;
+
+    int32_t shard = (int32_t)(crc % (uint32_t)r->num_global_shards) -
+                    r->shard_offset;
+    if (shard < 0 || shard >= r->num_shards) return -5;
+    int32_t lane = shard_fill[shard];
+    if (lane >= lanes) return -4;
+    uint8_t is_init = 0;
+    int32_t slot = shard_lookup(&r->shards[shard], fp, now, duration,
+                                r->pack_seq, &is_init);
+    if (is_init) push_commit(r, shard, slot);
+
+    int64_t o = ((int64_t)shard * lanes + lane) * 2;
+    packed[o] = (int64_t)(slot + 1) | ((int64_t)is_init << 32) |
+                ((int64_t)algo << 33) | (hits << 34);
+    packed[o + 1] = limit | (duration << 32);
+    out_shard[n] = shard;
+    out_lane[n] = lane;
+    shard_fill[shard] = lane + 1;
+    n++;
+  }
+  return n;
+}
+
+// Encode the fetched compact response (cword = i64[num_local_shards, lanes,
+// 2]) as a serialized GetRateLimitsResp for the n requests at
+// (out_shard[i], out_lane[i]).  Returns the byte length, or -1 if out_cap
+// is too small.
+int64_t fastpath_encode(const int64_t* cword, int64_t now, int32_t lanes,
+                        int64_t n, const int32_t* out_shard,
+                        const int32_t* out_lane, uint8_t* out,
+                        int64_t out_cap) {
+  uint8_t* w = out;
+  uint8_t* wend = out + out_cap;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t o = ((int64_t)out_shard[i] * lanes + out_lane[i]) * 2;
+    int64_t word = cword[o];
+    int64_t limit = cword[o + 1];
+    int64_t remaining = word & 0x7FFFFFFFll;
+    int64_t status = (word >> 31) & 1;
+    int64_t enc = (word >> 32) & 0xFFFFFFFFll;
+    int64_t reset = enc == 0 ? 0 : now + enc - 1;
+
+    // RateLimitResp: status=1, limit=2, remaining=3, reset_time=4
+    // (proto3: zero-valued fields are omitted)
+    int body = 0;
+    if (status) body += 1 + varint_size((uint64_t)status);
+    if (limit) body += 1 + varint_size((uint64_t)limit);
+    if (remaining) body += 1 + varint_size((uint64_t)remaining);
+    if (reset) body += 1 + varint_size((uint64_t)reset);
+    if (w + 1 + varint_size((uint64_t)body) + body > wend) return -1;
+    *w++ = (1u << 3) | 2;  // GetRateLimitsResp.responses
+    w = write_varint(w, (uint64_t)body);
+    if (status) {
+      *w++ = (1u << 3) | 0;
+      w = write_varint(w, (uint64_t)status);
+    }
+    if (limit) {
+      *w++ = (2u << 3) | 0;
+      w = write_varint(w, (uint64_t)limit);
+    }
+    if (remaining) {
+      *w++ = (3u << 3) | 0;
+      w = write_varint(w, (uint64_t)remaining);
+    }
+    if (reset) {
+      *w++ = (4u << 3) | 0;
+      w = write_varint(w, (uint64_t)reset);
+    }
+  }
+  return w - out;
 }
 
 int64_t router_size(Router* r) {

@@ -8,6 +8,7 @@ reference's stats-handler pipeline (prometheus.go:104-145).
 
 from __future__ import annotations
 
+import asyncio
 import time
 from typing import Optional
 
@@ -15,24 +16,50 @@ import grpc
 
 from gubernator_tpu.api import pb
 from gubernator_tpu.api.grpc_api import add_peers_servicer, add_v1_servicer
+from gubernator_tpu.api.types import millisecond_now
+from gubernator_tpu.core.fastpath import FastPath
 from gubernator_tpu.core.service import BatchTooLargeError, Instance
+
+# Only RPCs at least this large take the immediate fast path; smaller ones
+# keep the batching window so many tiny concurrent RPCs aggregate into one
+# dispatch (the reference's BATCHING default, peers.go:143-172).  ~32B/item
+# on the wire, so this is roughly a 64-item batch.
+FASTPATH_MIN_BYTES = 2048
 
 
 class _V1Servicer:
     def __init__(self, instance: Instance):
         self.instance = instance
+        self.fastpath = FastPath(instance.engine)
 
-    async def GetRateLimits(self, request, context):
-        m = self.instance.metrics
+    async def GetRateLimits(self, data: bytes, context):
+        inst = self.instance
+        m = inst.metrics
         start = time.monotonic()
+        if (self.fastpath.enabled and len(data) >= FASTPATH_MIN_BYTES
+                and not inst.mesh_mode and inst._picker.size() == 0):
+            out = await asyncio.get_running_loop().run_in_executor(
+                inst.batcher._executor,
+                self.fastpath.handle, data, millisecond_now())
+            if out is not None:
+                m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start,
+                              ok=True)
+                return out
         try:
-            resps = await self.instance.get_rate_limits(
+            request = pb.GetRateLimitsReq.FromString(data)
+        except Exception:
+            m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=False)
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                "malformed GetRateLimitsReq")
+        try:
+            resps = await inst.get_rate_limits(
                 [pb.req_from_pb(r) for r in request.requests])
         except BatchTooLargeError as e:
             m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=False)
             await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
         m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=True)
-        return pb.GetRateLimitsResp(responses=[pb.resp_to_pb(r) for r in resps])
+        return pb.GetRateLimitsResp(
+            responses=[pb.resp_to_pb(r) for r in resps]).SerializeToString()
 
     async def HealthCheck(self, request, context):
         # the reference's stats-handler observes EVERY RPC, HealthCheck

@@ -19,6 +19,7 @@ which it uses for hit/miss accounting and to prefer reclaiming expired slots.
 
 from __future__ import annotations
 
+import heapq
 from collections import OrderedDict
 from typing import Optional, Tuple
 
@@ -31,24 +32,51 @@ class SlotTable:
     cache-miss path regardless of what the slot's previous tenant left behind.
     """
 
-    __slots__ = ("capacity", "_entries", "_free", "hits", "misses")
+    __slots__ = ("capacity", "_entries", "_free", "hits", "misses",
+                 "_seq", "_uncommitted", "_expiry_heap")
 
     def __init__(self, capacity: int):
         if capacity <= 0:
             raise ValueError("capacity must be positive")
         self.capacity = capacity
-        # key -> [slot, expire_estimate_ms]; insertion order == LRU order
-        # (oldest first), maintained with move_to_end on access.
+        # key -> [slot, expire_estimate_ms, pending_init, seen_seq];
+        # insertion order == LRU order (oldest first), maintained with
+        # move_to_end on access.  pending_init stays set until a device
+        # dispatch commits the window that initialized the slot
+        # (commit_window): an aborted pack must NOT consume the init flag,
+        # or a retry could inherit a recycled slot's previous tenant's
+        # still-live device state.
         self._entries: "OrderedDict[str, list]" = OrderedDict()
         self._free = list(range(capacity - 1, -1, -1))
         self.hits = 0
         self.misses = 0
+        self._seq = 0
+        self._uncommitted: list = []
+        # lazy min-heap of (expire_estimate, key): lets a full table reclaim
+        # an EXPIRED slot before evicting a live LRU victim.  Entries go
+        # stale when a key is re-touched (its real expiry moved); staleness
+        # is detected on pop by comparing against the entry's current value.
+        self._expiry_heap: list = []
 
     def __len__(self) -> int:
         return len(self._entries)
 
     def __contains__(self, key: str) -> bool:
         return key in self._entries
+
+    def begin_window(self) -> None:
+        """Start packing a new window: later duplicate lookups of a
+        pending-init key within THIS window report is_init=False (the kernel
+        sequences in-window duplicates itself)."""
+        self._seq += 1
+        self._uncommitted = []
+
+    def commit_window(self) -> None:
+        """The window packed since begin_window was dispatched: its fresh
+        allocations are now device-initialized."""
+        for ent in self._uncommitted:
+            ent[2] = False
+        self._uncommitted = []
 
     def lookup(self, key: str, now: int, duration: int) -> Tuple[int, bool]:
         """Find or allocate the slot for `key`. Returns (slot, is_init)."""
@@ -60,19 +88,44 @@ class SlotTable:
                 self.misses += 1
             else:
                 self.hits += 1
-            ent[1] = now + duration
+            if ent[1] != now + duration:
+                ent[1] = now + duration
+                heapq.heappush(self._expiry_heap, (ent[1], key))
             self._entries.move_to_end(key)
+            if ent[2] and ent[3] != self._seq:
+                # allocated by an earlier window that never dispatched
+                ent[3] = self._seq
+                self._uncommitted.append(ent)
+                return ent[0], True
             return ent[0], False
 
         self.misses += 1
         if self._free:
             slot = self._free.pop()
         else:
-            # Evict the least-recently-used entry (lru.go:92-94,131-136).
-            _, old = self._entries.popitem(last=False)
-            slot = old[0]
-        self._entries[key] = [slot, now + duration]
+            slot = self._reclaim(now)
+        ent = [slot, now + duration, True, self._seq]
+        self._entries[key] = ent
+        heapq.heappush(self._expiry_heap, (now + duration, key))
+        self._uncommitted.append(ent)
         return slot, True
+
+    def _reclaim(self, now: int) -> int:
+        """Free a slot from a full table: prefer an EXPIRED entry (its
+        device state reads as a miss anyway, kernel lazy-TTL), falling back
+        to strict LRU eviction (lru.go:92-94,131-136)."""
+        heap = self._expiry_heap
+        while heap and heap[0][0] < now:
+            exp, key = heapq.heappop(heap)
+            ent = self._entries.get(key)
+            if ent is not None and ent[1] == exp:  # not stale: truly expired
+                del self._entries[key]
+                return ent[0]
+        if len(heap) > 4 * self.capacity:  # compact stale heap nodes
+            self._expiry_heap = [(e[1], k) for k, e in self._entries.items()]
+            heapq.heapify(self._expiry_heap)
+        _, old = self._entries.popitem(last=False)
+        return old[0]
 
     def peek(self, key: str) -> Optional[int]:
         """Slot for key without LRU touch or allocation; None if absent."""

@@ -1,0 +1,138 @@
+"""Native fast serving path: differential vs the full Python path.
+
+The fast path (core/fastpath.py + host_router.cc fastpath_parse/encode)
+must produce byte-level GetRateLimitsResp content identical to what the
+slow path computes for the same requests, and must REFUSE (fall back)
+whenever a request needs semantics it doesn't implement.
+"""
+
+import numpy as np
+import pytest
+
+import gubernator_tpu  # noqa: F401
+from gubernator_tpu import native
+from gubernator_tpu.api import pb
+from gubernator_tpu.api.types import Algorithm, Behavior, RateLimitReq
+from gubernator_tpu.core.engine import RateLimitEngine
+from gubernator_tpu.core.fastpath import FastPath
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native router unavailable")
+
+T0 = 1_700_000_000_000
+
+
+def _mk(items):
+    return pb.GetRateLimitsReq(requests=[
+        pb.RateLimitReq(name=n, unique_key=k, hits=h, limit=l, duration=d,
+                        algorithm=a, behavior=b)
+        for (n, k, h, l, d, a, b) in items
+    ]).SerializeToString()
+
+
+def _engine(use_native):
+    return RateLimitEngine(capacity_per_shard=256, batch_per_shard=64,
+                           global_capacity=16, global_batch_per_shard=8,
+                           max_global_updates=8, use_native=use_native)
+
+
+def test_fastpath_matches_python_path():
+    fast_eng = _engine("on")
+    ref_eng = _engine(False)
+    fp = FastPath(fast_eng)
+    assert fp.enabled
+
+    rng = np.random.default_rng(3)
+    for w in range(6):
+        now = T0 + w * 250
+        items = []
+        for i in range(40):
+            key = f"k{rng.integers(0, 25)}"  # hot duplicates in-window
+            algo = int(rng.integers(0, 2))
+            hits = int(rng.integers(0, 4))
+            items.append(("fpd", key, hits, 10, 60_000, algo, 0))
+        data = _mk(items)
+        out = fp.handle(data, now)
+        assert out is not None
+        got = pb.GetRateLimitsResp.FromString(out)
+        want = ref_eng.process(
+            [RateLimitReq(name=n, unique_key=k, hits=h, limit=l, duration=d,
+                          algorithm=a) for (n, k, h, l, d, a, _) in items],
+            now=now)
+        assert len(got.responses) == len(want)
+        for j, (g, r) in enumerate(zip(got.responses, want)):
+            assert (g.status, g.limit, g.remaining, g.reset_time) == \
+                (int(r.status), r.limit, r.remaining, r.reset_time), (w, j)
+
+
+def test_fastpath_expiry_and_leaky_over_time():
+    fast_eng = _engine("on")
+    ref_eng = _engine(False)
+    fp = FastPath(fast_eng)
+    items = [("fpe", "x", 1, 3, 100, 1, 0)]  # leaky, 100ms duration
+    data = _mk(items)
+    req = [RateLimitReq(name="fpe", unique_key="x", hits=1, limit=3,
+                        duration=100, algorithm=Algorithm.LEAKY_BUCKET)]
+    for dt in (0, 10, 35, 36, 37, 500):  # leak steps + full expiry
+        now = T0 + dt
+        g = pb.GetRateLimitsResp.FromString(fp.handle(data, now)).responses[0]
+        r = ref_eng.process(req, now=now)[0]
+        assert (g.status, g.remaining, g.reset_time) == \
+            (int(r.status), r.remaining, r.reset_time), dt
+
+
+def test_fastpath_fallback_codes():
+    eng = _engine("on")
+    fp = FastPath(eng)
+    now = T0
+    # GLOBAL behavior -> full path
+    assert fp.handle(_mk([("f", "k", 1, 5, 1000, 0, int(Behavior.GLOBAL))]),
+                     now) is None
+    # empty unique_key -> full path (per-item error semantics)
+    assert fp.handle(_mk([("f", "", 1, 5, 1000, 0, 0)]), now) is None
+    # empty name -> full path
+    assert fp.handle(_mk([("", "k", 1, 5, 1000, 0, 0)]), now) is None
+    # invalid algorithm -> full path
+    assert fp.handle(_mk([("f", "k", 1, 5, 1000, 7, 0)]), now) is None
+    # out-of-compact-range limit -> full path
+    assert fp.handle(_mk([("f", "k", 1, 1 << 40, 1000, 0, 0)]), now) is None
+    # negative hits (encodes as 10-byte varint) -> full path
+    assert fp.handle(_mk([("f", "k", -1, 5, 1000, 0, 0)]), now) is None
+    # malformed bytes -> full path
+    assert fp.handle(b"\x0a\xff\xff\xff", now) is None
+    # nothing above may have dispatched or mutated counters
+    assert eng.windows_processed == 0
+
+
+def test_fastpath_lane_overflow_falls_back():
+    eng = _engine("on")
+    fp = FastPath(eng)
+    # 600 distinct keys over 8 shards x 64 lanes: some shard must overflow
+    items = [("fov", f"k{i}", 1, 10, 1000, 0, 0) for i in range(600)]
+    assert fp.handle(_mk(items), T0) is None
+    assert eng.windows_processed == 0
+
+
+def test_fastpath_interleaves_with_slow_path():
+    """Fast-path windows and engine.process windows share the same arena and
+    router; interleaving them must stay consistent."""
+    fast_eng = _engine("on")
+    ref_eng = _engine(False)
+    fp = FastPath(fast_eng)
+    req = [RateLimitReq(name="fi", unique_key="k", hits=1, limit=5,
+                        duration=60_000)]
+    data = _mk([("fi", "k", 1, 5, 60_000, 0, 0)])
+    seq_fast = []
+    seq_ref = []
+    for i in range(6):
+        now = T0 + i
+        if i % 2 == 0:
+            g = pb.GetRateLimitsResp.FromString(
+                fp.handle(data, now)).responses[0]
+            seq_fast.append((g.status, g.remaining))
+        else:
+            r = fast_eng.process(req, now=now)[0]
+            seq_fast.append((int(r.status), r.remaining))
+        r = ref_eng.process(req, now=now)[0]
+        seq_ref.append((int(r.status), r.remaining))
+    assert seq_fast == seq_ref

@@ -39,6 +39,9 @@ def _pack_once(r, keys, now=T0, lanes=8, shards=4, duration=1000):
                     np.full(n, duration, np.int64), np.zeros(n, np.int32),
                     now, lanes, out_slot, o_h, o_l, o_d, o_a, o_i,
                     oshard, olane, fill)
+    # these unit tests treat each pack as a dispatched window (the engine
+    # commits after every successful dispatch — init-pending protocol)
+    r.commit()
     return packed, out_slot, o_i, oshard, olane
 
 
