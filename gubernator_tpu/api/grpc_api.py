@@ -22,8 +22,8 @@ def add_v1_servicer(server: grpc.aio.Server, servicer) -> None:
 
     GetRateLimits is registered at the BYTES level (no grpc-layer proto
     codec): the servicer owns decode/encode so eligible RPCs can run the
-    native fast path (core/fastpath.py) without ever materializing Python
-    protobuf objects."""
+    native pipeline lane (core/pipeline.py) without ever materializing
+    Python protobuf objects."""
     handlers = {
         "GetRateLimits": grpc.unary_unary_rpc_method_handler(
             servicer.GetRateLimits,
@@ -42,7 +42,8 @@ def add_v1_servicer(server: grpc.aio.Server, servicer) -> None:
 
 
 def add_peers_servicer(server: grpc.aio.Server, servicer) -> None:
-    """servicer: async GetPeerRateLimits(req, ctx), UpdatePeerGlobals(req, ctx)."""
+    """servicer: async GetPeerRateLimits(req, ctx), UpdatePeerGlobals(req,
+    ctx), RegisterGlobals(req, ctx), ApplyGlobalRegistration(req, ctx)."""
     handlers = {
         "GetPeerRateLimits": grpc.unary_unary_rpc_method_handler(
             servicer.GetPeerRateLimits,
@@ -53,6 +54,17 @@ def add_peers_servicer(server: grpc.aio.Server, servicer) -> None:
             servicer.UpdatePeerGlobals,
             request_deserializer=pb.UpdatePeerGlobalsReq.FromString,
             response_serializer=pb.UpdatePeerGlobalsResp.SerializeToString,
+        ),
+        "RegisterGlobals": grpc.unary_unary_rpc_method_handler(
+            servicer.RegisterGlobals,
+            request_deserializer=pb.RegisterGlobalsReq.FromString,
+            response_serializer=pb.RegisterGlobalsResp.SerializeToString,
+        ),
+        "ApplyGlobalRegistration": grpc.unary_unary_rpc_method_handler(
+            servicer.ApplyGlobalRegistration,
+            request_deserializer=pb.ApplyGlobalRegistrationReq.FromString,
+            response_serializer=(
+                pb.ApplyGlobalRegistrationResp.SerializeToString),
         ),
     }
     server.add_generic_rpc_handlers(
@@ -89,4 +101,14 @@ class PeersV1Stub:
             f"/{PEERS_SERVICE}/UpdatePeerGlobals",
             request_serializer=pb.UpdatePeerGlobalsReq.SerializeToString,
             response_deserializer=pb.UpdatePeerGlobalsResp.FromString,
+        )
+        self.RegisterGlobals = channel.unary_unary(
+            f"/{PEERS_SERVICE}/RegisterGlobals",
+            request_serializer=pb.RegisterGlobalsReq.SerializeToString,
+            response_deserializer=pb.RegisterGlobalsResp.FromString,
+        )
+        self.ApplyGlobalRegistration = channel.unary_unary(
+            f"/{PEERS_SERVICE}/ApplyGlobalRegistration",
+            request_serializer=pb.ApplyGlobalRegistrationReq.SerializeToString,
+            response_deserializer=pb.ApplyGlobalRegistrationResp.FromString,
         )

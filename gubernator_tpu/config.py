@@ -36,10 +36,17 @@ class BehaviorConfig:
     global_sync_wait: float = 0.0005
     global_timeout: float = 0.5
     global_batch_limit: int = MAX_BATCH_SIZE
+    # Mesh (lockstep) serving only: windows dispatched per tick, all as ONE
+    # stacked device call (engine.step_stacked).  Every process in the mesh
+    # MUST use the same value — the stacked executable's shape is part of
+    # the collective contract.  1 = classic one-window ticks.
+    lockstep_stack: int = 1
 
     def validate(self) -> None:
         if self.batch_limit > MAX_BATCH_SIZE:
             raise ValueError(f"Behaviors.BatchLimit cannot exceed '{MAX_BATCH_SIZE}'")
+        if self.lockstep_stack < 1:
+            raise ValueError("Behaviors.lockstep_stack must be >= 1")
 
 
 @dataclass
@@ -52,6 +59,11 @@ class EngineConfig:
     global_capacity: int = 4096
     global_batch_per_shard: int = 256
     max_global_updates: int = 256
+    # Opt-in exact-key collision guard in the native router (env:
+    # GUBER_EXACT_KEYS=1): stores full key bytes so a 64-bit fingerprint
+    # collision probes onward instead of merging two keys' counters.
+    # Costs ~key-length bytes per resident key.
+    exact_keys: bool = False
 
 
 @dataclass
@@ -204,6 +216,8 @@ def config_from_env(env_file: Optional[str] = None) -> DaemonConfig:
         b.global_timeout = float(_env("GUBER_GLOBAL_TIMEOUT"))
     if _env("GUBER_GLOBAL_BATCH_LIMIT"):
         b.global_batch_limit = int(_env("GUBER_GLOBAL_BATCH_LIMIT"))
+    if _env("GUBER_LOCKSTEP_STACK"):
+        b.lockstep_stack = int(_env("GUBER_LOCKSTEP_STACK"))
     b.validate()
 
     e = c.engine

@@ -24,6 +24,7 @@ from gubernator_tpu.api.types import RateLimitReq, RateLimitResp
 from gubernator_tpu.config import BehaviorConfig
 from gubernator_tpu.core.engine import RateLimitEngine
 from gubernator_tpu.core.interval import ArmedInterval
+from gubernator_tpu.core.pipeline import DispatchPipeline
 
 
 class WindowBatcher:
@@ -57,6 +58,30 @@ class WindowBatcher:
         # and stops after dispatching exactly that many windows, so no host
         # is left waiting on a collective that will never be issued.
         self.stop_at_tick: Optional[int] = None
+        # The pipelined serving lane (core/pipeline.py): compact-eligible
+        # non-GLOBAL traffic coalesces into stacked dispatches with the fetch
+        # overlapped; everything else (GLOBAL, out-of-range configs, no
+        # native router, lockstep mode) stays on the legacy lanes below.
+        self.pipeline: Optional[DispatchPipeline] = None
+        if lockstep_clock is None:
+            self.pipeline = DispatchPipeline(engine, self._executor, metrics)
+            self.pipeline.legacy = self._legacy_process
+
+    async def _legacy_process(self, reqs: Sequence[RateLimitReq]
+                              ) -> List[RateLimitResp]:
+        """Full-path processing for pipeline fallbacks (chunking, full wire
+        format, every semantic)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._executor, lambda: self.engine.process(reqs))
+
+    async def submit_rpc(self, data: bytes):
+        """Serve a whole serialized GetRateLimitsReq through the pipeline;
+        None => caller must use the full path (including in lockstep mode,
+        which has no pipeline)."""
+        if self.pipeline is None:
+            return None
+        return await self.pipeline.submit_rpc(data)
 
     def start_lockstep(self) -> None:
         """Begin the lockstep tick loop (mesh mode; call inside the loop)."""
@@ -79,11 +104,15 @@ class WindowBatcher:
             if delay > 0:
                 await asyncio.sleep(delay)
             try:
-                window = self._take_window()
+                if self.behaviors.lockstep_stack > 1:
+                    windows = [self._take_window()
+                               for _ in range(self.behaviors.lockstep_stack)]
+                else:
+                    windows = [self._take_window()]
             except Exception:  # defensive: the tick loop must never die
-                window = []
+                windows = [[]]
             try:
-                await self._run_lockstep_window(window)
+                await self._run_lockstep_window(windows)
             except Exception:
                 # dispatch irrecoverably failed (see the fail-stop in
                 # _run_lockstep_window): stop ticking and fail everything
@@ -117,38 +146,56 @@ class WindowBatcher:
         window, self._pending = ok[:fit], ok[fit:]
         return window
 
-    async def _run_lockstep_window(self, window: List[tuple]) -> None:
-        reqs = [w[0] for w in window]
-        accumulate = [w[1] for w in window]
+    async def _run_lockstep_window(self, windows: List[List[tuple]]) -> None:
+        """Dispatch one tick: `windows` is the tick's window list —
+        length 1 (classic) or lockstep_stack (stacked, one device call via
+        engine.step_stacked).  Either way the tick issues EXACTLY one
+        dispatch of the tick's agreed executable shape."""
+        stacked = self.behaviors.lockstep_stack > 1
         now = self.clock.next_now()
         loop = asyncio.get_running_loop()
         start = time.monotonic()
+        n_reqs = sum(len(w) for w in windows)
         # Structural invariant: this tick issues EXACTLY one device dispatch,
         # no matter what step() does.  windows_processed increments once per
-        # dispatch, so compare it instead of guessing whether step() raised
-        # before or after its device work.
+        # dispatch (K times for a stacked tick), so compare it instead of
+        # guessing whether step() raised before or after its device work.
         before = self.engine.windows_processed
+
+        def run():
+            if stacked:
+                return self.engine.step_stacked(
+                    [[t[0] for t in w] for w in windows], now,
+                    [[t[1] for t in w] for w in windows],
+                    k_stack=self.behaviors.lockstep_stack)
+            w = windows[0]
+            return [self.engine.step([t[0] for t in w], now,
+                                     [t[1] for t in w])]
+
+        def run_empty():
+            if stacked:
+                return self.engine.step_stacked(
+                    [[]], now, k_stack=self.behaviors.lockstep_stack)
+            return self.engine.step([], now)
+
         try:
-            resps = await loop.run_in_executor(
-                self._executor,
-                lambda: self.engine.step(reqs, now, accumulate))
+            resps = await loop.run_in_executor(self._executor, run)
         except Exception as e:
-            for _, _, fut in window:
-                if not fut.done():
-                    fut.set_exception(e)
+            for w in windows:
+                for _, _, fut in w:
+                    if not fut.done():
+                        fut.set_exception(e)
             if self.engine.windows_processed == before:
-                # step() raised before any device work: issue the tick's
+                # step raised before any device work: issue the tick's
                 # collective so the other processes' dispatches pair up
-                # (an empty step() dispatches exactly once on both backends).
+                # (an empty dispatch has the same executable shape).
                 # Retry transient failures — skipping the dispatch entirely
                 # would desync this host's collective sequence permanently,
                 # which is worse than blocking the tick (the other hosts just
                 # wait in the collective, which is ordinary backpressure).
                 for attempt in range(3):
                     try:
-                        await loop.run_in_executor(
-                            self._executor,
-                            lambda: self.engine.step([], now))
+                        await loop.run_in_executor(self._executor, run_empty)
                         break
                     except Exception:
                         if attempt == 2:
@@ -158,18 +205,22 @@ class WindowBatcher:
                             raise
                         await asyncio.sleep(0.05)
             return
-        if self.metrics is not None and window:
+        if self.metrics is not None and n_reqs:
             self.metrics.window_count.inc()
-            self.metrics.window_occupancy.observe(len(reqs))
+            self.metrics.window_occupancy.observe(n_reqs)
             self.metrics.window_duration.observe(time.monotonic() - start)
-        for (_, _, fut), resp in zip(window, resps):
-            if not fut.done():
-                fut.set_result(resp)
+        for w, rs in zip(windows, resps):
+            for (_, _, fut), resp in zip(w, rs):
+                if not fut.done():
+                    fut.set_result(resp)
 
     # ------------------------------------------------------------- batched
 
     async def submit(self, req: RateLimitReq, accumulate: bool = True) -> RateLimitResp:
         """Queue into the current window; resolves when the window executes."""
+        if (self.pipeline is not None and accumulate
+                and self.pipeline.eligible(req)):
+            return await self.pipeline.submit_one(req)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         if self._failed:
             raise RuntimeError("lockstep dispatch failed; "
@@ -233,6 +284,9 @@ class WindowBatcher:
         join the queue and ride the next cluster tick."""
         loop = asyncio.get_running_loop()
         acc = list(accumulate) if accumulate is not None else [True] * len(reqs)
+        if (self.pipeline is not None and reqs and all(acc)
+                and all(self.pipeline.eligible(r) for r in reqs)):
+            return await self.pipeline.submit_many(reqs)
         if self.clock is not None:
             futs = [loop.create_future() for _ in reqs]
             self._pending.extend(
@@ -261,6 +315,8 @@ class WindowBatcher:
 
     def close(self) -> None:
         self._closed = True
+        if self.pipeline is not None:
+            self.pipeline.close()
         if self._interval is not None:
             self._interval.stop()
         if self._tick_task is not None:

@@ -51,6 +51,13 @@ from gubernator_tpu.parallel.mesh import SHARD_AXIS, make_mesh
 from gubernator_tpu.state.arena import SlotTable
 
 
+# Stacked-window buckets for the serving pipeline (core/pipeline.py): a
+# drain dispatches its windows padded up to the nearest bucket, and
+# warmup() pre-compiles exactly these shapes.  Single source of truth —
+# a bucket missing here would compile mid-serving on the engine thread.
+PIPELINE_K_BUCKETS = (1, 2, 4, 8)
+
+
 def shard_of(key: str, num_shards: int) -> int:
     """Map a hash key to its owning shard.
 
@@ -125,6 +132,7 @@ class RateLimitEngine:
         global_batch_per_shard: int = 256,
         max_global_updates: int = 256,
         use_native: str = "auto",
+        exact_keys: bool = False,
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.num_shards = int(np.prod(list(self.mesh.shape.values())))
@@ -193,6 +201,11 @@ class RateLimitEngine:
         # single-process)
         self.tables = [SlotTable(C) for _ in range(self.num_local_shards)]
         self.gtable = SlotTable(G)
+        # dynamic mesh registrations applied (phase 1) but not yet activated
+        # mesh-wide (phase 2) — not servable until then
+        self._gpending: set = set()
+        # step_stacked staging, cached per stack depth K
+        self._stacked_bufs: dict = {}
         self._buf = _PackedWindow(self.num_local_shards, batch_per_shard,
                                   global_batch_per_shard, max_global_updates)
         self._step_fn = self._build_step()
@@ -209,6 +222,11 @@ class RateLimitEngine:
         self._compact_enabled = not self.multiprocess
         self.windows_processed = 0
         self.decisions_processed = 0
+        # occupied-prefix lane buckets (see _lane_bucket): powers-of-4 steps
+        # down from B, floored at 64 — at most 3 shapes per executable family
+        B = batch_per_shard
+        self._lane_bucket_list = sorted(
+            {b for b in (max(64, B // 16), max(64, B // 4)) if b < B} | {B})
 
         # Native C++ window router (gubernator_tpu/native): batch key hashing,
         # shard routing, slot lookup + LRU in one C call per window, replacing
@@ -222,6 +240,13 @@ class RateLimitEngine:
                     self.num_local_shards, C,
                     num_global_shards=S,
                     shard_offset=self.local_shard_offset)
+                # opt-in exact-key guard (GUBER_EXACT_KEYS=1 or
+                # EngineConfig.exact_keys): store full keys so a 64-bit
+                # fingerprint collision probes onward instead of silently
+                # merging two keys' counters
+                import os
+                if exact_keys or os.environ.get("GUBER_EXACT_KEYS") == "1":
+                    self.native.set_exact_keys()
             elif use_native != "auto":
                 raise RuntimeError("native router requested but unavailable")
 
@@ -293,25 +318,62 @@ class RateLimitEngine:
                 buf.pexpire[i] = st.reset_time if is_token else now + u.duration
                 buf.palgo[i] = u.algorithm
 
+        lanes, gcfg_upd, greset, max_fill, g_count = self._stage_requests(
+            buf, requests, now, accumulate)
+
+        for i, (slot, cfg) in enumerate(gcfg_upd.items()):
+            buf.uslot[i] = slot
+            buf.ulimit[i], buf.uduration[i], buf.ualgo[i] = cfg
+        for i, slot in enumerate(greset):
+            buf.rslot[i] = slot
+
+        out, gout = self._dispatch(
+            now, reg_fill=max_fill, fetch_global=g_count > 0)
+        for t in self.tables:
+            t.commit_window()
+        self.gtable.commit_window()
+
+        self.decisions_processed += len(requests)
+
+        responses = []
+        for s, lane, is_global in lanes:
+            o = gout if is_global else out
+            responses.append(
+                RateLimitResp(
+                    status=int(o.status[s, lane]),
+                    limit=int(o.limit[s, lane]),
+                    remaining=int(o.remaining[s, lane]),
+                    reset_time=int(o.reset_time[s, lane]),
+                )
+            )
+        return responses
+
+    def _stage_requests(self, buf, requests, now, accumulate):
+        """Stage one window's requests into `buf` (the engine's
+        _PackedWindow, or a per-window view over stacked staging arrays —
+        anything exposing the same lane arrays).
+
+        Returns (lanes, gcfg_upd, greset, max_reg_fill, g_count) where
+        lanes is [(shard, lane, is_global)] per request for demux."""
+        S = self.num_shards
         reg_fill = [0] * self.num_local_shards
         glob_fill = [0] * self.num_local_shards
         # slot -> (limit, duration, algo): latest request's config wins within
         # the window (deduped host-side — a device scatter with duplicate
         # indices has no ordering guarantee)
         gcfg_upd = {}
-        greset = []
-        # (shard, lane, is_global) per request, for demux
+        greset: List[int] = []
         lanes: List[tuple] = []
 
         g_count = 0
         for i, r in enumerate(requests):
             key = r.hash_key()
             if r.behavior == Behavior.GLOBAL:
-                if not self._dynamic_global and key not in self.gtable:
+                if not self._dynamic_global and not self.global_ready(key):
                     raise ValueError(
                         f"GLOBAL key {key!r} is not registered; mesh mode "
-                        "requires register_global_keys at boot (identical on "
-                        "every process)")
+                        "registers GLOBAL keys through the registrar "
+                        "(core/service.py) before serving them")
                 slot, is_init = self.gtable.lookup(key, now, r.duration)
                 contribute = accumulate is None or accumulate[i]
                 if contribute and self._dynamic_global:
@@ -357,33 +419,214 @@ class RateLimitEngine:
                 buf.algo[s, lane] = r.algorithm
                 buf.is_init[s, lane] = is_init
                 lanes.append((s, lane, False))
+        return lanes, gcfg_upd, greset, max(reg_fill, default=0), g_count
 
-        for i, (slot, cfg) in enumerate(gcfg_upd.items()):
-            buf.uslot[i] = slot
-            buf.ulimit[i], buf.uduration[i], buf.ualgo[i] = cfg
-        for i, slot in enumerate(greset):
-            buf.rslot[i] = slot
+    def step_stacked(
+        self,
+        windows: Sequence[Sequence[RateLimitReq]],
+        now: Optional[int] = None,
+        accumulates: Optional[Sequence[Optional[Sequence[bool]]]] = None,
+        k_stack: Optional[int] = None,
+    ) -> List[List[RateLimitResp]]:
+        """K serving windows in ONE device dispatch — the lockstep
+        saturation path (the mesh analog of the reference's back-to-back
+        queue drain, peers.go:143-172).
 
-        out, gout = self._dispatch(now)
+        Semantics equal K sequential step() calls at the same `now`, with
+        one documented divergence in single-process dynamic-GLOBAL mode:
+        per-request GLOBAL config refreshes from ALL windows merge
+        (last-wins) and apply once before window 0, because the stacked
+        executable applies the control plane only there
+        (_compiled_multi_step).  Mesh mode has no dynamic GLOBAL config, so
+        its semantics are exact.
+
+        Mesh mode: every process must call this in lockstep with the SAME
+        `k_stack` (the executable's shape is part of the collective
+        contract), the same cluster-agreed `now`, and its own local
+        windows.  `k_stack` pads the stack with empty windows so a fixed
+        tick shape can carry a variable backlog.
+        """
+        now = self._resolve_now(now)
+        K = k_stack if k_stack is not None else max(len(windows), 1)
+        if len(windows) > K:
+            raise ValueError(f"{len(windows)} windows exceed k_stack={K}")
+        SL, B = self.num_local_shards, self.batch_per_shard
+        Bg, Kg = self.global_batch_per_shard, self.max_global_updates
+        G = self.global_capacity
+
+        # Per-K cached stacked staging (the hot lockstep path ticks every
+        # batch_wait; reuse is safe because this method fetches the
+        # responses before returning, so the previous tick's transfer is
+        # complete).  Reset like _PackedWindow.reset: PAD slots drop lanes;
+        # other fields only matter on non-PAD lanes except ghits_acc, whose
+        # stale values would leak into the psum via jnp.zeros scatter-add.
+        st = self._stacked_bufs.get(K)
+        if st is None:
+            st = _PackedWindow.__new__(_PackedWindow)
+            st.slot = np.empty((K, SL, B), np.int32)
+            st.hits = np.empty((K, SL, B), np.int64)
+            st.limit = np.empty((K, SL, B), np.int64)
+            st.duration = np.empty((K, SL, B), np.int64)
+            st.algo = np.empty((K, SL, B), np.int32)
+            st.is_init = np.empty((K, SL, B), bool)
+            st.gslot = np.empty((K, SL, Bg), np.int32)
+            st.ghits = np.empty((K, SL, Bg), np.int64)
+            st.ghits_acc = np.empty((K, SL, Bg), np.int64)
+            st.glimit = np.empty((K, SL, Bg), np.int64)
+            st.gduration = np.empty((K, SL, Bg), np.int64)
+            st.galgo = np.empty((K, SL, Bg), np.int32)
+            st.gis_init = np.empty((K, SL, Bg), bool)
+            self._stacked_bufs[K] = st
+        st.slot.fill(kernel.PAD_SLOT)
+        st.gslot.fill(kernel.PAD_SLOT)
+        st.ghits_acc.fill(0)
+
+        class _View:
+            """One window's writable slice of the stacked staging arrays."""
+            def __init__(self, k):
+                for f in ("slot", "hits", "limit", "duration", "algo",
+                          "is_init", "gslot", "ghits", "ghits_acc",
+                          "glimit", "gduration", "galgo", "gis_init"):
+                    setattr(self, f, getattr(st, f)[k])
+
+        for t in self.tables:
+            t.begin_window()
+        self.gtable.begin_window()
+        if self.native is not None:
+            self.native.drain_begin()
+        all_lanes: List[List[tuple]] = []
+        merged_upd: dict = {}
+        merged_reset: List[int] = []
+        try:
+            for k, reqs in enumerate(windows):
+                acc = accumulates[k] if accumulates is not None else None
+                if self.native is None:
+                    lanes, gcfg_upd, greset, _, _ = self._stage_requests(
+                        _View(k), reqs, now, acc)
+                else:
+                    lanes, gcfg_upd, greset = self._stage_window_native(
+                        _View(k), reqs, now, acc)
+                all_lanes.append(lanes)
+                merged_upd.update(gcfg_upd)
+                merged_reset.extend(greset)
+            if len(merged_upd) > Kg or len(merged_reset) > Kg:
+                raise ValueError("stacked windows carry more GLOBAL config "
+                                 f"updates than max_global_updates ({Kg})")
+        except Exception:
+            # staging failed before dispatch: keep the drain's fresh
+            # allocations pending (their slots were never initialized on
+            # device; the next touch must re-init them)
+            if self.native is not None:
+                self.native.abort()
+            raise
+
+        uslot = np.full((Kg,), G, np.int32)
+        ulimit = np.zeros((Kg,), np.int64)
+        uduration = np.zeros((Kg,), np.int64)
+        ualgo = np.zeros((Kg,), np.int32)
+        rslot = np.full((Kg,), G, np.int32)
+        for i, (slot, cfg) in enumerate(merged_upd.items()):
+            uslot[i] = slot
+            ulimit[i], uduration[i], ualgo[i] = cfg
+        for i, slot in enumerate(merged_reset):
+            rslot[i] = slot
+        _, _, _, ups = self.empty_control()
+
+        batches = WindowBatch(slot=st.slot, hits=st.hits, limit=st.limit,
+                              duration=st.duration, algo=st.algo,
+                              is_init=st.is_init)
+        gbatches = WindowBatch(slot=st.gslot, hits=st.ghits, limit=st.glimit,
+                               duration=st.gduration, algo=st.galgo,
+                               is_init=st.gis_init)
+        nows = np.full((K,), now, np.int64)
+
+        try:
+            fused = self.step_windows(
+                batches, gbatches, st.ghits_acc,
+                (uslot, ulimit, uduration, ualgo, rslot), ups, nows,
+                n_decisions=sum(len(w) for w in windows))
+        except Exception:
+            if self.native is not None:
+                self.native.abort()
+            raise
         for t in self.tables:
             t.commit_window()
         self.gtable.commit_window()
+        if self.native is not None:
+            self.native.commit()
 
-        self.windows_processed += 1
-        self.decisions_processed += len(requests)
-
-        responses = []
-        for s, lane, is_global in lanes:
-            o = gout if is_global else out
-            responses.append(
-                RateLimitResp(
+        fused = self._fetch_local_stacked(fused)
+        responses: List[List[RateLimitResp]] = []
+        for k, lanes in enumerate(all_lanes):
+            out, gout = kernel.split_outputs(fused[k], B)
+            resp = []
+            for s, lane, is_global in lanes:
+                o = gout if is_global else out
+                resp.append(RateLimitResp(
                     status=int(o.status[s, lane]),
                     limit=int(o.limit[s, lane]),
                     remaining=int(o.remaining[s, lane]),
                     reset_time=int(o.reset_time[s, lane]),
-                )
-            )
+                ))
+            responses.append(resp)
         return responses
+
+    def _stage_window_native(self, view, requests, now, accumulate):
+        """step_stacked staging with the C router resolving regular keys
+        (the native sibling of _stage_requests; must run inside a
+        native drain_begin .. commit/abort bracket).  GLOBAL lanes keep the
+        Python gtable path as everywhere else."""
+        B = self.batch_per_shard
+        reg_idx, glob_idx = [], []
+        for i, r in enumerate(requests):
+            (glob_idx if r.behavior == Behavior.GLOBAL else reg_idx).append(i)
+        lanes: List[Optional[tuple]] = [None] * len(requests)
+
+        if reg_idx:
+            keys_b = [requests[i].hash_key().encode("utf-8") for i in reg_idx]
+            key_bytes = np.frombuffer(b"".join(keys_b), dtype=np.uint8)
+            key_ends = np.cumsum([len(k) for k in keys_b]).astype(np.int64)
+            n = len(reg_idx)
+            out_shard = np.empty(n, np.int32)
+            out_lane = np.empty(n, np.int32)
+            shard_fill = np.zeros(self.num_local_shards, np.int32)
+            packed = self.native.pack_window(
+                key_bytes, key_ends,
+                np.asarray([requests[i].hits for i in reg_idx], np.int64),
+                np.asarray([requests[i].limit for i in reg_idx], np.int64),
+                np.asarray([requests[i].duration for i in reg_idx], np.int64),
+                np.asarray([requests[i].algorithm for i in reg_idx],
+                           np.int32),
+                now, B,
+                view.slot, view.hits, view.limit, view.duration, view.algo,
+                view.is_init.view(np.uint8),
+                out_shard, out_lane, shard_fill,
+            )
+            if packed < n:
+                raise ValueError(
+                    "stacked window overflows batch_per_shard — size "
+                    "windows with max_window_prefix before step_stacked")
+            bad = out_shard < 0
+            if bad.any():
+                r_bad = requests[reg_idx[int(np.argmax(bad))]]
+                raise ValueError(
+                    f"key {r_bad.hash_key()!r} belongs to shard "
+                    f"{shard_of(r_bad.hash_key(), self.num_shards)}, "
+                    "not owned by this process")
+            for j, i in enumerate(reg_idx):
+                lanes[i] = (int(out_shard[j]), int(out_lane[j]), False)
+
+        gcfg_upd: dict = {}
+        greset: List[int] = []
+        if glob_idx:
+            greqs = [requests[i] for i in glob_idx]
+            gacc = ([accumulate[i] for i in glob_idx]
+                    if accumulate is not None else None)
+            glanes, gcfg_upd, greset, _, _ = self._stage_requests(
+                view, greqs, now, gacc)
+            for (s, lane, is_global), i in zip(glanes, glob_idx):
+                lanes[i] = (s, lane, is_global)
+        return lanes, gcfg_upd, greset
 
     def _process_native(
         self,
@@ -516,10 +759,10 @@ class RateLimitEngine:
             while gpos + len(glanes) < len(glob):
                 i, r, contribute = glob[gpos + len(glanes)]
                 key = r.hash_key()
-                if not self._dynamic_global and key not in self.gtable:
+                if not self._dynamic_global and not self.global_ready(key):
                     raise ValueError(
                         f"GLOBAL key {key!r} is not registered; mesh mode "
-                        "requires register_global_keys at boot")
+                        "registers GLOBAL keys through the registrar")
                 if g_count + 1 > self.num_local_shards * self.global_batch_per_shard:
                     break
                 if len(gcfg_upd) + 1 > self.max_global_updates:
@@ -550,7 +793,9 @@ class RateLimitEngine:
                     and (pos < nreg or gpos < len(glob))):
                 raise RuntimeError("window packing made no progress")
 
-            out, gout = self._dispatch(now)
+            out, gout = self._dispatch(
+                now, reg_fill=int(shard_fill.max()) if packed else 0,
+                fetch_global=bool(glanes))
             self.native.commit()
             self.gtable.commit_window()
             if packed:
@@ -576,7 +821,6 @@ class RateLimitEngine:
                 )
             pos += packed
             gpos += len(glanes)
-            self.windows_processed += 1
             self.decisions_processed += packed + len(glanes)
 
         return responses  # type: ignore[return-value]
@@ -609,13 +853,23 @@ class RateLimitEngine:
         `compact_safe=True` — promising every lane satisfies the
         COMPACT_MAX_* ranges — compact dispatch is permanently disabled to
         keep the saturation guard sound (see ops/kernel.py format note).
+
+        Mesh mode: inputs are this process's LOCAL staging blocks
+        ([K, S_local, ...]); every process must dispatch in lockstep with
+        the SAME K (the stacked executable's shape is part of the
+        collective contract) and identical replicated upd/ups/nows.
         """
-        if self.multiprocess:
-            raise NotImplementedError(
-                "stacked dispatch in mesh mode lands with the lockstep "
-                "window clock integration")
         if not compact_safe:
             self._compact_enabled = False
+        if self.multiprocess:
+            batches = WindowBatch(*[self._sharded_in_stacked(np.asarray(a))
+                                    for a in batches])
+            gbatches = WindowBatch(*[self._sharded_in_stacked(np.asarray(a))
+                                     for a in gbatches])
+            gaccs = self._sharded_in_stacked(np.asarray(gaccs))
+            upd = tuple(self._repl_in(a) for a in upd)
+            ups = tuple(self._repl_in(a) for a in ups)
+            nows = self._repl_in(np.asarray(nows, np.int64))
         self.state, fused, self.gstate, self.gcfg = self._multi_fn(
             self.state, self.gstate, self.gcfg, batches, gbatches, gaccs,
             upd, ups, nows,
@@ -655,59 +909,116 @@ class RateLimitEngine:
         return gbatch, gacc, upd, ups
 
     def register_global_keys(self, specs: Sequence[tuple],
-                             now: Optional[int] = None) -> None:
-        """Pre-register GLOBAL limits: (key, limit, duration, algorithm).
+                             now: Optional[int] = None,
+                             pending: bool = False) -> None:
+        """Register GLOBAL limits: (key, limit, duration, algorithm).
 
-        In mesh mode this is the ONLY way GLOBAL keys enter the replicated
-        arena: every process must call it at boot with the IDENTICAL ordered
-        list (and the identical `now`), which makes the replicated config
-        writes — the part of GLOBAL traffic that cannot ride the psum —
-        bit-identical on every replica.  Single-process engines may also use
-        it as a config preload; dynamic per-request registration stays
-        available there.
+        Runs through a COLLECTIVE-FREE replicated executable
+        (_compiled_global_register): it only scatters into the replicated
+        gstate/gcfg arrays, so in mesh mode each process may run it at its
+        own wall time — no lockstep tick needed — provided every process
+        applies the IDENTICAL ordered batches with the identical `now`
+        (boot preload, or registrar-ordered dynamic batches; see
+        core/service.py register_globals).  Until a batch is applied on a
+        process, that process has no lanes for the keys, so the slots'
+        psum deltas are zero everywhere and replicas cannot diverge.
+
+        pending=True (dynamic mesh registration, phase 1): the keys are
+        allocated and configured but NOT yet servable — routing_error keeps
+        rejecting them until activate_global_keys (phase 2, issued by the
+        registrar only after EVERY process applied phase 1, so no host
+        contributes hits to a slot some replica hasn't configured).
+
+        Mesh-determinism guard: in mesh mode registration only ever
+        allocates from the free list — when the arena is full it FAILS
+        instead of reclaiming, because reclaim/LRU order depends on each
+        host's local serving history and would diverge the replicated slot
+        assignment.
         """
         now = self._resolve_now(now)
-        buf = self._buf
         K = self.max_global_updates
+        G = self.global_capacity
         # last-wins dedupe BEFORE staging: duplicate keys would put duplicate
         # indices in one device scatter, whose ordering XLA does not define
         deduped = {key: (key, limit, duration, algorithm)
                    for key, limit, duration, algorithm in specs}
         specs = list(deduped.values())
+        if self.multiprocess:
+            new = sum(1 for s in specs if s[0] not in self.gtable)
+            if len(self.gtable) + new > G:
+                raise ValueError(
+                    f"GLOBAL arena full ({G} slots): mesh-mode registration "
+                    "never reclaims (host-local LRU order would diverge the "
+                    "replicated slot assignment); raise global_capacity")
+        fn = _compiled_global_register(self.mesh)
         for base in range(0, len(specs), K):
             chunk = specs[base:base + K]
-            buf.reset(self.global_capacity)
             self.gtable.begin_window()
+            uslot = np.full((K,), G, np.int32)
+            ulimit = np.zeros((K,), np.int64)
+            uduration = np.zeros((K,), np.int64)
+            ualgo = np.zeros((K,), np.int32)
+            rslot = np.full((K,), G, np.int32)
             r = 0
             for i, (key, limit, duration, algorithm) in enumerate(chunk):
                 slot, is_init = self.gtable.lookup(key, now, duration)
-                buf.uslot[i] = slot
-                buf.ulimit[i] = limit
-                buf.uduration[i] = duration
-                buf.ualgo[i] = algorithm
+                uslot[i] = slot
+                ulimit[i] = limit
+                uduration[i] = duration
+                ualgo[i] = algorithm
                 if is_init:
-                    buf.rslot[r] = slot
+                    rslot[r] = slot
                     r += 1
-            self._dispatch(now)
+                if pending:
+                    self._gpending.add(key)
+            upd = tuple(self._repl_in(a) for a in
+                        (uslot, ulimit, uduration, ualgo, rslot))
+            self.gstate, self.gcfg = fn(self.gstate, self.gcfg, upd)
             self.gtable.commit_window()
-            self.windows_processed += 1
 
-    def warmup(self, now: Optional[int] = None) -> None:
-        """Compile and execute one empty window per serving executable so
-        serving never pays the jit.  Mesh mode: pass the cluster-agreed
-        timestamp (every process must warm up in lockstep).
+    def activate_global_keys(self, keys: Sequence[str]) -> None:
+        """Phase 2 of dynamic mesh registration: begin serving the keys
+        (every process has applied their phase-1 arena writes)."""
+        self._gpending.difference_update(keys)
+
+    def global_ready(self, key: str) -> bool:
+        """Is this GLOBAL hash key servable on this engine right now?"""
+        return key in self.gtable and key not in self._gpending
+
+    def warmup(self, now: Optional[int] = None,
+               k_stack: Optional[int] = None) -> None:
+        """Compile and execute one empty window per serving executable —
+        every lane bucket of both wire formats, plus the pipeline's
+        stacked-window buckets — so serving never pays a jit stall (a
+        cluster's 500ms peer deadline does not survive a mid-serving
+        compile).  Mesh mode: pass the cluster-agreed timestamp (every
+        process must warm up in lockstep), and the tick's lockstep_stack as
+        `k_stack` so the stacked tick executable compiles here too.
 
         (An empty `process()` call is a no-op on the native path, so callers
         that need the compile — cluster boot, daemon start — use this.)"""
         now = self._resolve_now(now)
+        if k_stack is not None and k_stack > 1:
+            self.step_stacked([[]], now, k_stack=k_stack)
+        # full format compiles only at full width (it is the rare fallback
+        # once compact serving is up; each extra shape is a whole XLA
+        # compile, which over a tunneled chip costs tens of seconds)
         saved = self._compact_enabled
         self._compact_enabled = False
         self._buf.reset(self.global_capacity)
         self._dispatch(now)
         self._compact_enabled = saved
         if saved:
-            self._buf.reset(self.global_capacity)
-            self._dispatch(now)
+            for lanes in self._lane_bucket_list:
+                self._buf.reset(self.global_capacity)
+                self._dispatch(now, reg_fill=lanes)
+        if self.native is not None and not self.multiprocess:
+            for kb in PIPELINE_K_BUCKETS:
+                packed = np.zeros(
+                    (kb, self.num_shards, self.batch_per_shard, 2), np.int64)
+                _, _, mism = self.pipeline_dispatch(
+                    packed, np.full(kb, now, np.int64), n_windows=0)
+            jax.device_get(mism)
 
     def _resolve_now(self, now: Optional[int]) -> int:
         """Default `now` to wall clock — except in mesh mode, where the
@@ -755,6 +1066,14 @@ class RateLimitEngine:
         return jax.make_array_from_process_local_data(
             self._shard_sharding, local_np, gshape)
 
+    def _sharded_in_stacked(self, local_np):
+        """Local [K, S_local, ...] stacked staging -> global [K, S, ...]."""
+        if not self.multiprocess:
+            return local_np
+        gshape = ((local_np.shape[0], self.num_shards) + local_np.shape[2:])
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, P(None, SHARD_AXIS)), local_np, gshape)
+
     def _repl_in(self, arr):
         """Replicated input: every process MUST pass identical values."""
         if not self.multiprocess:
@@ -772,19 +1091,62 @@ class RateLimitEngine:
                         key=lambda s: s.index[0].start or 0)
         return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
 
-    def _dispatch(self, now: int):
+    def _fetch_local_stacked(self, arr):
+        """Like _fetch_local for a stacked output [K, S, ...]: this
+        process's blocks along the shard axis -> [K, S_local, ...]."""
+        if not self.multiprocess:
+            return jax.device_get(arr)
+        shards = sorted(arr.addressable_shards,
+                        key=lambda s: s.index[1].start or 0)
+        return np.concatenate([np.asarray(s.data) for s in shards], axis=1)
+
+    def _lane_bucket(self, max_fill: int) -> int:
+        """Occupied-prefix lane width: the smallest compiled lane-bucket
+        >= max_fill.  Slicing the staged window to the occupied prefix makes
+        the host<->device transfer proportional to occupancy instead of to
+        batch_per_shard (a 1000-request window in a 32k-lane engine otherwise
+        moves 32x more bytes than it has lanes).  Buckets are powers-of-4
+        steps of B so at most 3 executables exist per step family.
+
+        Mesh mode always uses the full width: the bucket choice is
+        per-host data-dependent, and hosts picking different executables
+        for the same lockstep tick would wedge the collectives."""
+        if self.multiprocess:
+            return self.batch_per_shard
+        for b in self._lane_bucket_list:
+            if b >= max_fill:
+                return b
+        return self.batch_per_shard
+
+    def _dispatch(self, now: int, reg_fill: Optional[int] = None,
+                  fetch_global: bool = True):
         """Run the staged buffers through the device step; returns host copies
         of the (regular, global) outputs.
 
         The transfer is the dominant per-window fixed cost (catastrophically
         so on a tunneled chip; PCIe-bound otherwise), so eligible windows use
-        the compact wire format (_compiled_step_compact) and everything else
-        a single fused fetch (_compiled_step).
+        the compact wire format (_compiled_step_compact), slice the regular
+        lanes to the occupied-prefix bucket (reg_fill = max per-shard fill;
+        None = full width), and skip fetching the GLOBAL output block when the
+        window carries no GLOBAL lanes (fetch_global=False -> gout is None).
+
+        `windows_processed` increments immediately after the device call is
+        issued — before any fetch/demux — so it counts exactly the dispatches
+        the device saw (the lockstep batcher's parity accounting relies on
+        this, core/batcher.py).
 
         In mesh mode every process must call this in lockstep (same dispatch
         sequence), staging its own local lanes; replicated control inputs
         (upd/ups/now) must be identical everywhere."""
         buf = self._buf
+        compact = self._compact_eligible(buf)
+        # Occupied-prefix buckets apply only to the compact path: the full
+        # format is the rare fallback and warmup compiles it only at full
+        # width, so slicing it would trigger a mid-serving XLA compile per
+        # bucket shape.
+        lanes = (self._lane_bucket(reg_fill)
+                 if compact and reg_fill is not None
+                 else self.batch_per_shard)
         gbatch = WindowBatch(
             slot=self._sharded_in(buf.gslot), hits=self._sharded_in(buf.ghits),
             limit=self._sharded_in(buf.glimit),
@@ -800,33 +1162,61 @@ class RateLimitEngine:
             buf.ptstamp, buf.pexpire, buf.palgo))
         now_in = self._repl_in(np.int64(now)) if self.multiprocess \
             else jnp.int64(now)
-        if self._compact_eligible(buf):
+        if compact:
             packed = self._sharded_in(kernel.encode_batch_host(
-                buf.slot, buf.hits, buf.limit, buf.duration, buf.algo,
-                buf.is_init))
+                buf.slot[:, :lanes], buf.hits[:, :lanes],
+                buf.limit[:, :lanes], buf.duration[:, :lanes],
+                buf.algo[:, :lanes], buf.is_init[:, :lanes]))
             self.state, cword, gfused, self.gstate, self.gcfg = self._compact_fn(
                 self.state, self.gstate, self.gcfg, packed, gbatch,
                 gacc, upd, ups, now_in,
             )
+            self.windows_processed += 1
             out = kernel.decode_output_host(self._fetch_local(cword), now)
+            if not fetch_global:
+                return out, None
             gfused = self._fetch_local(gfused)
             gout = WindowOutput(
                 status=gfused[..., 0], limit=gfused[..., 1],
                 remaining=gfused[..., 2], reset_time=gfused[..., 3])
             return out, gout
         batch = WindowBatch(
-            slot=self._sharded_in(buf.slot), hits=self._sharded_in(buf.hits),
-            limit=self._sharded_in(buf.limit),
-            duration=self._sharded_in(buf.duration),
-            algo=self._sharded_in(buf.algo),
-            is_init=self._sharded_in(buf.is_init),
+            slot=self._sharded_in(buf.slot[:, :lanes]),
+            hits=self._sharded_in(buf.hits[:, :lanes]),
+            limit=self._sharded_in(buf.limit[:, :lanes]),
+            duration=self._sharded_in(buf.duration[:, :lanes]),
+            algo=self._sharded_in(buf.algo[:, :lanes]),
+            is_init=self._sharded_in(buf.is_init[:, :lanes]),
         )
         self.state, fused, self.gstate, self.gcfg = self._step_fn(
             self.state, self.gstate, self.gcfg, batch, gbatch, gacc,
             upd, ups, now_in,
         )
-        return kernel.split_outputs(
-            self._fetch_local(fused), self.batch_per_shard)
+        self.windows_processed += 1
+        return kernel.split_outputs(self._fetch_local(fused), lanes)
+
+    def pipeline_dispatch(self, packed, nows, n_windows: Optional[int] = None):
+        """Dispatch a stacked compact drain (core/pipeline.py) WITHOUT
+        fetching: K serving windows in one device call, regular keys only
+        (GLOBAL traffic needs the control plane + psum and rides the legacy
+        step path, serialized on the same executor thread).
+
+        packed: i64[K, S, B, 2] compact request stack (numpy or resident);
+        nows: i64[K] per-window timestamps.  Returns un-fetched device
+        arrays (words i64[K, S, B], limits i64[K, S, B], mism bool[K, S]):
+        the caller overlaps their fetch with the next drain's dispatch and
+        reads `limits` only when a mismatch flag fired (see
+        kernel.encode_output_word).
+        """
+        if self.multiprocess:
+            raise NotImplementedError(
+                "the dispatch pipeline is standalone-only; mesh serving "
+                "dispatches on the lockstep clock")
+        fn = _compiled_pipeline_step(self.mesh)
+        self.state, words, limits, mism = fn(self.state, packed, nows)
+        self.windows_processed += (int(packed.shape[0]) if n_windows is None
+                                   else n_windows)
+        return words, limits, mism
 
     def process(
         self,
@@ -866,9 +1256,9 @@ class RateLimitEngine:
         instead of letting a packing exception skip a mesh tick."""
         key = r.hash_key()
         if r.behavior == Behavior.GLOBAL:
-            if not self._dynamic_global and key not in self.gtable:
+            if not self._dynamic_global and not self.global_ready(key):
                 return (f"GLOBAL key {key!r} is not registered; mesh mode "
-                        "requires register_global_keys at boot")
+                        "registers GLOBAL keys through the registrar")
             return None
         s = shard_of(key, self.num_shards)
         if not 0 <= s - self.local_shard_offset < self.num_local_shards:
@@ -1095,6 +1485,90 @@ def _compiled_step_compact(mesh: Mesh):
         ),
     )
     return jax.jit(sharded, donate_argnums=(0, 1, 2))
+
+
+@lru_cache(maxsize=None)
+def _compiled_global_register(mesh: Mesh):
+    """GLOBAL registration writes into the replicated arena — deliberately
+    COLLECTIVE-FREE (pure scatters on fully-replicated arrays), so mesh
+    processes may execute it at different wall times without wedging the
+    lockstep: there is nothing to synchronize.  Correctness across hosts
+    comes from every process applying identical registrar-ordered batches
+    (see RateLimitEngine.register_global_keys)."""
+    repl6 = BucketState(*[NamedSharding(mesh, P())] * 6)
+    repl3 = GlobalConfig(*[NamedSharding(mesh, P())] * 3)
+
+    def fn(gstate: BucketState, gcfg: GlobalConfig, upd):
+        uslot, ulimit, uduration, ualgo, rslot = upd
+        gcfg = GlobalConfig(
+            limit=gcfg.limit.at[uslot].set(ulimit, mode="drop"),
+            duration=gcfg.duration.at[uslot].set(uduration, mode="drop"),
+            algo=gcfg.algo.at[uslot].set(ualgo, mode="drop"),
+        )
+        # expire=0 reads as never-initialized: a freshly (re)allocated slot
+        # must not inherit its previous tenant's live counters
+        gstate = gstate._replace(
+            expire=gstate.expire.at[rslot].set(jnp.int64(0), mode="drop"))
+        return gstate, gcfg
+
+    return jax.jit(fn, donate_argnums=(0, 1),
+                   out_shardings=(repl6, repl3))
+
+
+@lru_cache(maxsize=None)
+def _compiled_pipeline_step(mesh: Mesh):
+    """K compact serving windows in ONE device dispatch — the drain
+    executable of the serving pipeline (core/pipeline.py).
+
+    Differences from _compiled_multi_step, all in service of making the
+    response transfer as small and as late-bound as possible (on a remote/
+    tunneled chip the fetch round trip IS the serving cost; on PCIe it still
+    bounds small-window latency):
+
+      * regular keys only — GLOBAL traffic needs the psum + control-plane
+        writes and rides the legacy step path instead, so this executable
+        carries zero GLOBAL inputs and outputs;
+      * requests arrive in the compact 16B/lane format (kernel.decode_batch)
+        and responses leave as ONE 8B word per lane (encode_output_word);
+      * the response's `limit` field (stored limit, which on hit paths can
+        differ from the request's) is NOT shipped per lane: the host echoes
+        the request limit and fetches the device-side limit plane only when
+        a window's mismatch flag fires (config changed on a live bucket —
+        rare).
+
+    The reference analog of the stacking is a peer draining its queue
+    back-to-back without waiting for each response (peers.go:143-172).
+    """
+    def shard_fn(state, packed, nows):
+        # Block shapes: state [1, C]; packed [K, 1, B, 2]; nows [K].
+        st = BucketState(*jax.tree.map(lambda a: a[0], state))
+
+        def body(st, xs):
+            pk, now = xs
+            bt = kernel.decode_batch(pk[0])
+            st, out = kernel.window_step(st, bt, now)
+            word = kernel.encode_output_word(out, now)
+            mism = jnp.any((out.limit != bt.limit) & (bt.slot >= 0))
+            return st, (word, out.limit, mism)
+
+        st, (words, limits, mism) = lax.scan(body, st, (packed, nows))
+        expand = lambda a: a[None]
+        return (
+            BucketState(*jax.tree.map(expand, st)),
+            words[:, None],
+            limits[:, None],
+            mism[:, None],
+        )
+
+    state_sharded = BucketState(*[P(SHARD_AXIS)] * 6)
+    stackedP = P(None, SHARD_AXIS)
+    sharded = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(state_sharded, stackedP, P()),
+        out_specs=(state_sharded, stackedP, stackedP, stackedP),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
 
 
 @lru_cache(maxsize=None)

@@ -70,6 +70,7 @@ class Instance:
             global_capacity=e.global_capacity,
             global_batch_per_shard=e.global_batch_per_shard,
             max_global_updates=e.max_global_updates,
+            exact_keys=e.exact_keys,
         )
         self.metrics.watch_engine(self.engine)
         self.mesh_mode = mesh_peers is not None
@@ -94,6 +95,24 @@ class Instance:
         self.mesh_peers = list(mesh_peers) if mesh_peers else None
         self.health = HealthCheckResp(status=HEALTHY, peer_count=0)
         self.advertise_address = self.conf.advertise_address
+        # dynamic mesh GLOBAL registration (reference analog: GLOBAL keys
+        # are accepted on first use, global.go:62-68): process 0 is the
+        # registrar that totally orders registrations mesh-wide
+        self._greg_lock = asyncio.Lock()
+        self._greg_inflight: Dict[str, asyncio.Future] = {}
+        # registrar-side: keys whose TWO-PHASE registration completed on
+        # every process.  Deliberately not the registrar's own
+        # engine.global_ready: a partial phase-2 failure leaves a key active
+        # here but pending elsewhere, and the retry must re-run both phases
+        # (idempotent) to heal the stuck host.
+        self._greg_done: set = set()
+
+    @property
+    def standalone(self) -> bool:
+        """No peer ring and not a mesh: this node owns every key (the gate
+        for the native RPC lane, re-checked again on the engine thread via
+        pipeline.rpc_enabled — see server.py / core/pipeline.py)."""
+        return not self.mesh_mode and self._picker.size() == 0
 
     # ------------------------------------------------------------ public API
 
@@ -121,6 +140,24 @@ class Instance:
         if self._picker.size() == 0:
             return await self._local(r)
 
+        if r.behavior == Behavior.GLOBAL and self.mesh_mode:
+            # ownership is irrelevant here: after the window psum EVERY mesh
+            # replica is authoritative for GLOBAL keys
+            try:
+                if not self.engine.global_ready(key):
+                    # first sight of this GLOBAL key: register it mesh-wide
+                    # through the registrar before serving (reference
+                    # analog: GLOBAL keys accepted on first use,
+                    # global.go:62-68)
+                    await self._ensure_global_registered(r)
+                return await self.batcher.submit(r)
+            except Exception as e:
+                # per-item failure (e.g. unregistered GLOBAL key failed
+                # individually by _take_window) must not abort the whole
+                # client batch via the gather in get_rate_limits
+                return RateLimitResp(
+                    error=f"while applying rate limit for '{key}' - '{e}'")
+
         try:
             peer = self._picker.get(key)
         except Exception as e:
@@ -135,16 +172,6 @@ class Instance:
                     error=f"while applying rate limit for '{key}' - '{e}'")
 
         if r.behavior == Behavior.GLOBAL:
-            if self.mesh_mode:
-                try:
-                    # every mesh replica is authoritative after the window psum
-                    return await self.batcher.submit(r)
-                except Exception as e:
-                    # per-item failure (e.g. unregistered GLOBAL key failed
-                    # individually by _take_window) must not abort the whole
-                    # client batch via the gather in get_rate_limits
-                    return RateLimitResp(
-                        error=f"while applying rate limit for '{key}' - '{e}'")
             try:
                 return await self._global_nonowner(r)
             except Exception as e:
@@ -179,6 +206,78 @@ class Instance:
         # replica read through the engine's global arena; hits stay out of
         # the mesh psum (they reconcile via the owner instead)
         return await self.batcher.submit(r, accumulate=False)
+
+    # --------------------------------------------- dynamic mesh GLOBAL keys
+
+    async def _ensure_global_registered(self, r: RateLimitReq) -> None:
+        """Route a first-seen GLOBAL key's registration through the mesh
+        registrar (process 0) and wait until it is servable HERE.  In-flight
+        registrations for the same key coalesce into one RPC."""
+        key = r.hash_key()
+        fut = self._greg_inflight.get(key)
+        if fut is None:
+            fut = asyncio.get_running_loop().create_future()
+            self._greg_inflight[key] = fut
+            try:
+                registrar = self._picker.get_by_host(self.mesh_peers[0])
+                if registrar is None:
+                    raise RuntimeError("mesh registrar peer is not connected")
+                await registrar.register_globals(
+                    [(key, r.limit, r.duration, int(r.algorithm))])
+                if not fut.done():
+                    fut.set_result(None)
+            except Exception as e:
+                if not fut.done():
+                    fut.set_exception(e)
+                raise
+            finally:
+                self._greg_inflight.pop(key, None)
+            return
+        await fut
+
+    async def register_globals(self, specs) -> None:
+        """Registrar endpoint (runs on mesh process 0): totally order
+        dynamic GLOBAL registrations and two-phase-apply them.  Phase 1
+        writes the replicated arena on EVERY process (collective-free, see
+        engine.register_global_keys); phase 2 activates serving only after
+        every process confirmed phase 1 — so no host ever contributes psum
+        hits to a slot some replica hasn't configured."""
+        if not self.mesh_mode:
+            raise RuntimeError("RegisterGlobals is a mesh-mode RPC")
+        async with self._greg_lock:
+            todo = list({s[0]: s for s in specs
+                         if s[0] not in self._greg_done}.values())
+            if not todo:
+                return
+            from gubernator_tpu.api.types import millisecond_now
+            now = millisecond_now()
+            peers = [self._picker.get_by_host(h) for h in self.mesh_peers]
+            if any(p is None for p in peers):
+                raise RuntimeError(
+                    "mesh peers not all connected; cannot register "
+                    "GLOBAL keys")
+            await asyncio.gather(*(
+                p.apply_global_registration(todo, now, False)
+                for p in peers))
+            await asyncio.gather(*(
+                p.apply_global_registration(todo, now, True) for p in peers))
+            self._greg_done.update(s[0] for s in todo)
+
+    async def apply_global_registration(self, specs, now: int,
+                                        activate: bool) -> None:
+        """One registration phase on THIS process (registrar fan-out
+        target); engine work runs on the device executor thread."""
+        loop = asyncio.get_running_loop()
+        if activate:
+            keys = [s[0] for s in specs]
+            await loop.run_in_executor(
+                self.batcher._executor,
+                lambda: self.engine.activate_global_keys(keys))
+        else:
+            await loop.run_in_executor(
+                self.batcher._executor,
+                lambda: self.engine.register_global_keys(
+                    specs, now=now, pending=True))
 
     # ------------------------------------------------------------ peer plane
 
@@ -263,6 +362,12 @@ class Instance:
             message="|".join(errs),
             peer_count=picker.size(),
         )
+        if self.batcher.pipeline is not None:
+            # the raw-RPC lane is only sound while standalone (the C parser
+            # routes by crc % num_shards, not the peer ring); flip the flag
+            # the drain re-reads on the engine thread
+            self.batcher.pipeline.rpc_enabled = (
+                self.batcher.pipeline.enabled and self.standalone)
         if not self.mesh_mode:
             # mesh mode replicates GLOBAL state through the in-mesh psum;
             # the gRPC async-hits/broadcast loops stay off

@@ -87,7 +87,8 @@ class Daemon:
         # cluster-agreed timestamp (all processes warm up in lockstep)
         if mesh_peers is not None:
             eng = self.instance.engine
-            eng.warmup(now=self.instance.batcher.clock.epoch_ms)
+            eng.warmup(now=self.instance.batcher.clock.epoch_ms,
+                       k_stack=c.behaviors.lockstep_stack)
             gk_file = os.environ.get("GUBER_GLOBAL_KEYS_FILE", "")
             if gk_file:
                 import json

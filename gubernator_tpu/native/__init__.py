@@ -55,26 +55,36 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.router_new_mesh.restype = ctypes.c_void_p
         lib.router_new_mesh.argtypes = [ctypes.c_int32] * 4
         lib.router_free.argtypes = [ctypes.c_void_p]
-        lib.router_pack.restype = ctypes.c_int64
-        lib.router_pack.argtypes = [
-            ctypes.c_void_p, u8p, i64p, ctypes.c_int64,
-            i64p, i64p, i64p, i32p, ctypes.c_int64, ctypes.c_int32,
-            i32p, i64p, i64p, i64p, i32p, u8p, i32p, i32p, i32p,
-        ]
+        for fn in ("router_pack", "router_pack_window"):
+            getattr(lib, fn).restype = ctypes.c_int64
+            getattr(lib, fn).argtypes = [
+                ctypes.c_void_p, u8p, i64p, ctypes.c_int64,
+                i64p, i64p, i64p, i32p, ctypes.c_int64, ctypes.c_int32,
+                i32p, i64p, i64p, i64p, i32p, u8p, i32p, i32p, i32p,
+            ]
         for fn in ("router_size", "router_hits", "router_misses"):
             getattr(lib, fn).restype = ctypes.c_int64
             getattr(lib, fn).argtypes = [ctypes.c_void_p]
-        lib.router_commit.restype = None
-        lib.router_commit.argtypes = [ctypes.c_void_p]
-        lib.fastpath_parse.restype = ctypes.c_int64
-        lib.fastpath_parse.argtypes = [
+        for fn in ("router_commit", "router_drain_begin", "router_abort",
+                   "router_set_exact"):
+            getattr(lib, fn).restype = None
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.fastpath_parse_stack.restype = ctypes.c_int64
+        lib.fastpath_parse_stack.argtypes = [
             ctypes.c_void_p, u8p, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int32, ctypes.c_int64, i64p, i32p, i32p, i32p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+            i64p, i32p, i32p, i32p, i32p, i64p,
         ]
-        lib.fastpath_encode.restype = ctypes.c_int64
-        lib.fastpath_encode.argtypes = [
-            i64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
-            i32p, i32p, u8p, ctypes.c_int64,
+        lib.router_pack_stack.restype = ctypes.c_int64
+        lib.router_pack_stack.argtypes = [
+            ctypes.c_void_p, u8p, i64p, ctypes.c_int64,
+            i64p, i64p, i64p, i32p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, i64p, i32p, i32p, i32p, i32p,
+        ]
+        lib.fastpath_encode_w.restype = ctypes.c_int64
+        lib.fastpath_encode_w.argtypes = [
+            i64p, i64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
+            i32p, i32p, i64p, u8p, ctypes.c_int64,
         ]
         _lib = lib
         return _lib
@@ -127,11 +137,26 @@ class NativeRouter:
     ) -> int:
         """Returns how many of the n requests were packed (< n on lane
         overflow; ship the window and repack the remainder)."""
-        n = len(key_ends)
-        return self._lib.router_pack(
+        return self._pack_impl(self._lib.router_pack, key_bytes, key_ends,
+                               hits, limits, durations, algos, now, lanes,
+                               out_slot, out_hits, out_limit, out_duration,
+                               out_algo, out_is_init, out_shard, out_lane,
+                               shard_fill)
+
+    def pack_window(self, *args) -> int:
+        """router_pack under an open drain (shared pack sequence,
+        accumulating commits): one caller-delimited window of a stacked
+        dispatch.  Same arguments and return as pack()."""
+        return self._pack_impl(self._lib.router_pack_window, *args)
+
+    def _pack_impl(self, fn, key_bytes, key_ends, hits, limits, durations,
+                   algos, now, lanes, out_slot, out_hits, out_limit,
+                   out_duration, out_algo, out_is_init, out_shard, out_lane,
+                   shard_fill) -> int:
+        return fn(
             self._handle,
             _ptr(key_bytes, ctypes.c_uint8), _ptr(key_ends, ctypes.c_int64),
-            n,
+            len(key_ends),
             _ptr(hits, ctypes.c_int64), _ptr(limits, ctypes.c_int64),
             _ptr(durations, ctypes.c_int64), _ptr(algos, ctypes.c_int32),
             now, lanes,
@@ -143,39 +168,82 @@ class NativeRouter:
         )
 
     def commit(self) -> None:
-        """Confirm the window staged by the last pack/parse was dispatched
-        (clears its entries' init-pending flags)."""
+        """Confirm the window(s) staged since the last drain_begin / pack
+        were dispatched (clears their entries' init-pending flags)."""
         self._lib.router_commit(self._handle)
 
-    def fastpath_parse(self, data: bytes, now: int, lanes: int,
-                       max_items: int, packed: np.ndarray,
-                       out_shard: np.ndarray, out_lane: np.ndarray,
-                       shard_fill: np.ndarray) -> int:
-        """Serialized GetRateLimitsReq -> staged compact window.
+    def drain_begin(self) -> None:
+        """Open a drain: one pack sequence shared by the following
+        parse_stack/pack_stack calls, committed or aborted as a unit."""
+        self._lib.router_drain_begin(self._handle)
 
-        Returns n >= 0 (requests staged) or a negative fallback code (the
-        caller must then run the full Python path); see host_router.cc."""
+    def abort(self) -> None:
+        """The drain's dispatch failed: keep its fresh allocations pending
+        so their next touch re-initializes the (never-written) slots."""
+        self._lib.router_abort(self._handle)
+
+    def set_exact_keys(self) -> None:
+        """Opt-in exact-key collision guard (stores full keys; a 64-bit
+        fingerprint collision then probes onward instead of merging two
+        keys' counters).  Call before any key is inserted."""
+        self._lib.router_set_exact(self._handle)
+
+    def fastpath_parse_stack(self, data: bytes, now: int, lanes: int,
+                             K: int, max_items: int, packed: np.ndarray,
+                             kcur: np.ndarray, shard_fill: np.ndarray,
+                             out_row: np.ndarray, out_lane: np.ndarray,
+                             out_limit: np.ndarray) -> int:
+        """Serialized GetRateLimitsReq -> lanes staged across a K-window
+        compact stack.  Returns n >= 0 (requests staged) or a negative
+        fallback code; see host_router.cc."""
         # zero-copy read-only view of the immutable bytes
         buf = ctypes.cast(ctypes.c_char_p(data),
                           ctypes.POINTER(ctypes.c_uint8))
-        return self._lib.fastpath_parse(
-            self._handle, buf, len(data), now, lanes, max_items,
-            _ptr(packed, ctypes.c_int64), _ptr(out_shard, ctypes.c_int32),
-            _ptr(out_lane, ctypes.c_int32), _ptr(shard_fill, ctypes.c_int32),
+        return self._lib.fastpath_parse_stack(
+            self._handle, buf, len(data), now, lanes, K, max_items,
+            _ptr(packed, ctypes.c_int64), _ptr(kcur, ctypes.c_int32),
+            _ptr(shard_fill, ctypes.c_int32),
+            _ptr(out_row, ctypes.c_int32), _ptr(out_lane, ctypes.c_int32),
+            _ptr(out_limit, ctypes.c_int64),
         )
 
-    def fastpath_encode(self, cword: np.ndarray, now: int, lanes: int,
-                        n: int, out_shard: np.ndarray, out_lane: np.ndarray,
-                        resp_buf: np.ndarray) -> int:
-        """Fetched compact response -> serialized GetRateLimitsResp bytes
-        (returns the length written into resp_buf)."""
-        m = self._lib.fastpath_encode(
-            _ptr(cword, ctypes.c_int64), now, lanes, n,
-            _ptr(out_shard, ctypes.c_int32), _ptr(out_lane, ctypes.c_int32),
-            _ptr(resp_buf, ctypes.c_uint8), resp_buf.nbytes,
+    def pack_stack(self, key_bytes: np.ndarray, key_ends: np.ndarray,
+                   hits: np.ndarray, limits: np.ndarray,
+                   durations: np.ndarray, algos: np.ndarray, now: int,
+                   lanes: int, K: int, packed: np.ndarray,
+                   kcur: np.ndarray, shard_fill: np.ndarray,
+                   out_row: np.ndarray, out_lane: np.ndarray) -> int:
+        """Columnar request list -> lanes staged across the K-window stack
+        (same drain protocol as fastpath_parse_stack)."""
+        return self._lib.router_pack_stack(
+            self._handle,
+            _ptr(key_bytes, ctypes.c_uint8), _ptr(key_ends, ctypes.c_int64),
+            len(key_ends),
+            _ptr(hits, ctypes.c_int64), _ptr(limits, ctypes.c_int64),
+            _ptr(durations, ctypes.c_int64), _ptr(algos, ctypes.c_int32),
+            now, lanes, K,
+            _ptr(packed, ctypes.c_int64), _ptr(kcur, ctypes.c_int32),
+            _ptr(shard_fill, ctypes.c_int32),
+            _ptr(out_row, ctypes.c_int32), _ptr(out_lane, ctypes.c_int32),
+        )
+
+    def fastpath_encode_w(self, w0: np.ndarray, item_limit: np.ndarray,
+                          now: int, lanes: int, n: int,
+                          out_row: np.ndarray, out_lane: np.ndarray,
+                          resp_buf: np.ndarray,
+                          climit: Optional[np.ndarray] = None) -> int:
+        """Fetched response-word plane -> serialized GetRateLimitsResp bytes
+        (returns the length written into resp_buf).  climit: the device's
+        limit plane, passed only when a stored-limit mismatch was flagged."""
+        cl = _ptr(climit, ctypes.c_int64) if climit is not None else None
+        m = self._lib.fastpath_encode_w(
+            _ptr(w0, ctypes.c_int64), _ptr(item_limit, ctypes.c_int64),
+            now, lanes, n,
+            _ptr(out_row, ctypes.c_int32), _ptr(out_lane, ctypes.c_int32),
+            cl, _ptr(resp_buf, ctypes.c_uint8), resp_buf.nbytes,
         )
         if m < 0:
-            raise RuntimeError("fastpath_encode: response buffer too small")
+            raise RuntimeError("fastpath_encode_w: response buffer too small")
         return m
 
     @property

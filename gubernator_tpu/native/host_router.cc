@@ -103,6 +103,11 @@ struct Shard {
   // comparing against the entry's live expire + residency.
   HeapNode* heap;
   int64_t heap_len, heap_cap;
+  // exact-key guard (opt-in, router_set_exact): stores each entry's full
+  // key so a 64-bit fingerprint collision probes onward instead of silently
+  // merging two keys' counters.  nullptr when disabled.
+  uint8_t** keys;
+  int32_t* klen;
 };
 
 struct Router {
@@ -110,9 +115,12 @@ struct Router {
   int32_t num_shards;         // local shards staged by this process
   int32_t num_global_shards;  // hashing modulus (== num_shards single-proc)
   int32_t shard_offset;       // first local shard's global index
-  uint32_t pack_seq;          // increments per pack/parse call
+  uint32_t pack_seq;          // increments per pack/parse call (or per drain)
   int64_t* commit_list;       // (shard << 32) | entry, pending inits staged
-  int64_t commit_len, commit_cap;  //   by the LAST pack/parse call
+  int64_t commit_len, commit_cap;  //   by the LAST pack/parse call or drain
+  int32_t exact;              // exact-key guard enabled
+  uint8_t* scratch;           // assembled hash_key scratch (exact mode)
+  int64_t scratch_cap;
 };
 
 uint32_t next_pow2(uint32_t v) {
@@ -141,6 +149,8 @@ void shard_init(Shard* s, int32_t capacity) {
   s->seq = (uint32_t*)calloc(capacity, sizeof(uint32_t));
   s->heap = nullptr;
   s->heap_len = s->heap_cap = 0;
+  s->keys = nullptr;
+  s->klen = nullptr;
 }
 
 // entry e is resident iff some table cell still points at it (cell_of is
@@ -262,13 +272,20 @@ int32_t try_reclaim_expired(Shard* s, int64_t now) {
 // is_init only once per pack call (later duplicates in the same window see
 // the in-window live register, kernel-side), but keeps reporting it across
 // pack calls until router_commit confirms a dispatch wrote the slot.
+// key/key_len: the full hash-key bytes, compared (and stored) only when the
+// exact-key guard is on — a fingerprint collision then probes onward to its
+// own cell instead of merging counters.
 int32_t shard_lookup(Shard* s, uint64_t fp, int64_t now, int64_t duration,
-                     uint32_t cur_seq, uint8_t* is_init) {
+                     uint32_t cur_seq, uint8_t* is_init,
+                     const uint8_t* key = nullptr, int64_t key_len = 0) {
   uint32_t cell = (uint32_t)(fp & s->mask);
   for (;;) {
     int32_t e = s->cells[cell];
     if (e == NIL) break;
-    if (s->fp[e] == fp) {
+    if (s->fp[e] == fp &&
+        (s->keys == nullptr ||
+         (s->klen[e] == (int32_t)key_len &&
+          memcmp(s->keys[e], key, key_len) == 0))) {
       if (s->expire[e] < now) s->misses++;  // expired touch counts as a miss
       else s->hits++;
       if (s->expire[e] != now + duration) {
@@ -313,6 +330,12 @@ int32_t shard_lookup(Shard* s, uint64_t fp, int64_t now, int64_t duration,
   lru_push_front(s, e);
   s->pending[e] = 1;
   s->seq[e] = cur_seq;
+  if (s->keys != nullptr) {
+    free(s->keys[e]);
+    s->keys[e] = (uint8_t*)malloc(key_len ? key_len : 1);
+    memcpy(s->keys[e], key, key_len);
+    s->klen[e] = (int32_t)key_len;
+  }
   *is_init = 1;
   return e;
 }
@@ -338,8 +361,46 @@ Router* router_new_mesh(int32_t num_global_shards, int32_t shard_offset,
   r->pack_seq = 0;
   r->commit_list = nullptr;
   r->commit_len = r->commit_cap = 0;
+  r->exact = 0;
+  r->scratch = nullptr;
+  r->scratch_cap = 0;
   return r;
 }
+
+// Enable the exact-key collision guard.  Must be called before any key is
+// inserted (entries allocated earlier have no stored key to compare).
+void router_set_exact(Router* r) {
+  r->exact = 1;
+  for (int32_t i = 0; i < r->num_shards; i++) {
+    Shard* s = &r->shards[i];
+    if (s->keys == nullptr) {
+      s->keys = (uint8_t**)calloc(s->capacity, sizeof(uint8_t*));
+      s->klen = (int32_t*)calloc(s->capacity, sizeof(int32_t));
+    }
+  }
+}
+
+// ---- drain protocol ------------------------------------------------------
+// A drain is one engine-thread batch of stacked staging calls
+// (fastpath_parse_stack / router_pack_stack) followed by ONE device
+// dispatch.  All calls share one pack sequence (so a key allocated by an
+// earlier call in the drain stops reporting is_init to later calls — its
+// init lane is already staged in an earlier window of the same stack), and
+// the pending-init commit list accumulates across the drain:
+//   router_drain_begin -> stage... -> dispatch -> router_commit
+//                                  \-> dispatch failed -> router_abort
+// router_abort keeps the staged entries pending, so their next touch
+// re-reports is_init and the device re-initializes the slot (the arena
+// never saw the failed windows).
+void router_drain_begin(Router* r) {
+  r->pack_seq++;
+  // belt-and-braces: a crashed previous drain that called neither commit
+  // nor abort must not have its pending inits cleared by THIS drain's
+  // commit (the entries stay pending, so their next touch re-inits)
+  r->commit_len = 0;
+}
+
+void router_abort(Router* r) { r->commit_len = 0; }
 
 // Confirm that the window staged by the LAST pack/parse call was actually
 // dispatched: its fresh allocations stop reporting is_init.
@@ -362,19 +423,24 @@ void router_free(Router* r) {
     free(s->cells); free(s->fp); free(s->expire); free(s->cell_of);
     free(s->prev); free(s->next); free(s->free_list);
     free(s->pending); free(s->seq); free(s->heap);
+    if (s->keys != nullptr) {
+      for (int32_t e = 0; e < s->capacity; e++) free(s->keys[e]);
+      free(s->keys);
+      free(s->klen);
+    }
   }
   free(r->shards);
   free(r->commit_list);
+  free(r->scratch);
   free(r);
 }
 
-// Resolve and pack one window.  Keys are concatenated UTF-8 bytes with
-// exclusive end offsets.  Output lane arrays are [num_shards * lanes]
-// row-major; slot lanes the packer doesn't fill must be pre-set to PAD by
-// the caller.  Returns the number of requests packed: < n means the next
-// request would overflow its shard's lane budget (caller ships this window
-// and repacks the rest).
-int64_t router_pack(
+namespace {
+
+// Shared body of router_pack / router_pack_window (the latter runs under
+// an open drain: one pack sequence and an accumulating commit list across
+// K caller-delimited windows, see router_drain_begin).
+int64_t pack_full_impl(
     Router* r,
     const uint8_t* key_bytes, const int64_t* key_ends, int64_t n,
     const int64_t* hits, const int64_t* limits, const int64_t* durations,
@@ -382,8 +448,6 @@ int64_t router_pack(
     int32_t* out_slot, int64_t* out_hits, int64_t* out_limit,
     int64_t* out_duration, int32_t* out_algo, uint8_t* out_is_init,
     int32_t* out_shard, int32_t* out_lane, int32_t* shard_fill) {
-  r->pack_seq++;
-  r->commit_len = 0;  // an uncommitted previous window stays pending
   for (int64_t i = 0; i < n; i++) {
     int64_t beg = i == 0 ? 0 : key_ends[i - 1];
     int64_t len = key_ends[i] - beg;
@@ -402,7 +466,7 @@ int64_t router_pack(
     if (lane >= lanes) return i;
     uint8_t is_init = 0;
     int32_t slot = shard_lookup(&r->shards[shard], fnv1a64(key, len), now,
-                                durations[i], r->pack_seq, &is_init);
+                                durations[i], r->pack_seq, &is_init, key, len);
     if (is_init) push_commit(r, shard, slot);
     int64_t o = (int64_t)shard * lanes + lane;
 
@@ -417,6 +481,50 @@ int64_t router_pack(
     shard_fill[shard] = lane + 1;
   }
   return n;
+}
+
+}  // namespace
+
+// Resolve and pack one window.  Keys are concatenated UTF-8 bytes with
+// exclusive end offsets.  Output lane arrays are [num_shards * lanes]
+// row-major; slot lanes the packer doesn't fill must be pre-set to PAD by
+// the caller.  Returns the number of requests packed: < n means the next
+// request would overflow its shard's lane budget (caller ships this window
+// and repacks the rest).
+int64_t router_pack(
+    Router* r,
+    const uint8_t* key_bytes, const int64_t* key_ends, int64_t n,
+    const int64_t* hits, const int64_t* limits, const int64_t* durations,
+    const int32_t* algos, int64_t now, int32_t lanes,
+    int32_t* out_slot, int64_t* out_hits, int64_t* out_limit,
+    int64_t* out_duration, int32_t* out_algo, uint8_t* out_is_init,
+    int32_t* out_shard, int32_t* out_lane, int32_t* shard_fill) {
+  r->pack_seq++;
+  r->commit_len = 0;  // an uncommitted previous window stays pending
+  return pack_full_impl(r, key_bytes, key_ends, n, hits, limits, durations,
+                        algos, now, lanes, out_slot, out_hits, out_limit,
+                        out_duration, out_algo, out_is_init, out_shard,
+                        out_lane, shard_fill);
+}
+
+// Drain-protocol sibling of router_pack: caller delimits the windows of a
+// stacked dispatch (RateLimitEngine.step_stacked) — one window per call,
+// output arrays pointed at that window's slice of the stacked staging —
+// under one router_drain_begin .. router_commit/router_abort bracket, so a
+// key first seen in window k reports is_init exactly once across the
+// whole stack.
+int64_t router_pack_window(
+    Router* r,
+    const uint8_t* key_bytes, const int64_t* key_ends, int64_t n,
+    const int64_t* hits, const int64_t* limits, const int64_t* durations,
+    const int32_t* algos, int64_t now, int32_t lanes,
+    int32_t* out_slot, int64_t* out_hits, int64_t* out_limit,
+    int64_t* out_duration, int32_t* out_algo, uint8_t* out_is_init,
+    int32_t* out_shard, int32_t* out_lane, int32_t* shard_fill) {
+  return pack_full_impl(r, key_bytes, key_ends, n, hits, limits, durations,
+                        algos, now, lanes, out_slot, out_hits, out_limit,
+                        out_duration, out_algo, out_is_init, out_shard,
+                        out_lane, shard_fill);
 }
 
 // ---- fast serving path --------------------------------------------------
@@ -493,29 +601,168 @@ constexpr int64_t COMPACT_MAX_DURATION = (1ll << 31) - 16;
 
 }  // namespace
 
-// Parse a serialized GetRateLimitsReq and stage it as a compact-format
-// window.  packed is i64[num_local_shards, lanes, 2], pre-zeroed by the
-// caller (w0 == 0 marks a padded lane).  Returns the request count n >= 0
-// on success, or:
+namespace {
+
+constexpr int32_t MAX_STACK_ITEMS = 1024;  // > MAX_BATCH_SIZE (1000)
+constexpr int32_t MAX_STACK_SHARDS = 256;
+
+struct ParsedItem {
+  const uint8_t* name;
+  int64_t name_len;
+  const uint8_t* key;
+  int64_t key_len;
+  int64_t hits, limit, duration;
+  uint32_t algo;
+  int32_t shard;  // local shard index
+  uint64_t fp;
+  int64_t scratch_off;  // assembled hash_key offset (exact mode)
+};
+
+// Parse one serialized RateLimitReq message body into *it (no validation).
+// Returns false on malformed bytes.
+bool parse_item(const uint8_t* q, const uint8_t* qend, ParsedItem* it,
+                uint64_t* behavior) {
+  it->name = nullptr;
+  it->name_len = 0;
+  it->key = nullptr;
+  it->key_len = 0;
+  it->hits = it->limit = it->duration = 0;
+  it->algo = 0;
+  *behavior = 0;
+  while (q < qend) {
+    uint64_t t;
+    if (!read_varint(&q, qend, &t)) return false;
+    uint64_t field = t >> 3;
+    int wt = (int)(t & 7);
+    if (wt == 2) {
+      uint64_t l;
+      if (!read_varint(&q, qend, &l) || l > (uint64_t)(qend - q))
+        return false;
+      if (field == 1) {
+        it->name = q;
+        it->name_len = (int64_t)l;
+      } else if (field == 2) {
+        it->key = q;
+        it->key_len = (int64_t)l;
+      }
+      q += l;
+    } else if (wt == 0) {
+      uint64_t v;
+      if (!read_varint(&q, qend, &v)) return false;
+      if (field == 3) it->hits = (int64_t)v;
+      else if (field == 4) it->limit = (int64_t)v;
+      else if (field == 5) it->duration = (int64_t)v;
+      else if (field == 6) it->algo = (uint32_t)v;
+      else if (field == 7) *behavior = v;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+// Per-shard stack-fit check shared by the two staging entry points: can
+// `demand[s]` more lanes be placed for every shard, given the monotonic
+// window cursors?  (Windows fill per shard in cursor order, so the free
+// space is the tail of the cursor's window plus every later window.)
+bool stack_fits(const int64_t* demand, const int32_t* kcur,
+                const int32_t* shard_fill, int32_t S, int32_t lanes,
+                int32_t K) {
+  for (int32_t s = 0; s < S; s++) {
+    if (!demand[s]) continue;
+    int64_t free_lanes = (int64_t)(lanes - shard_fill[kcur[s] * S + s]) +
+                         (int64_t)(K - 1 - kcur[s]) * lanes;
+    if (demand[s] > free_lanes) return false;
+  }
+  return true;
+}
+
+// Stage one resolved item into the window stack.  packed is
+// i64[K, S, lanes, 2]; out_row gets the flattened window-row index
+// (widx * S + shard) so the encoder can address the fetched [K*S, lanes]
+// response plane directly.
+inline void stage_lane(Router* r, int32_t shard, uint64_t fp,
+                       const uint8_t* key, int64_t key_len, int64_t now,
+                       int64_t hits, int64_t limit, int64_t duration,
+                       uint32_t algo, int32_t lanes, int32_t K,
+                       int64_t* packed, int32_t* kcur, int32_t* shard_fill,
+                       int32_t* out_row, int32_t* out_lane, int64_t i) {
+  int32_t S = r->num_shards;
+  int32_t k = kcur[shard];
+  if (shard_fill[k * S + shard] >= lanes) k = ++kcur[shard];
+  int32_t lane = shard_fill[k * S + shard]++;
+  uint8_t is_init = 0;
+  int32_t slot = shard_lookup(&r->shards[shard], fp, now, duration,
+                              r->pack_seq, &is_init, key, key_len);
+  if (is_init) push_commit(r, shard, slot);
+  int64_t row = (int64_t)k * S + shard;
+  int64_t o = (row * lanes + lane) * 2;
+  packed[o] = (int64_t)(slot + 1) | ((int64_t)is_init << 32) |
+              ((int64_t)algo << 33) | (hits << 34);
+  packed[o + 1] = limit | (duration << 32);
+  out_row[i] = (int32_t)row;
+  out_lane[i] = lane;
+}
+
+uint8_t* scratch_reserve(Router* r, int64_t need) {
+  if (need > r->scratch_cap) {
+    int64_t cap = r->scratch_cap ? r->scratch_cap : 4096;
+    while (cap < need) cap *= 2;
+    r->scratch = (uint8_t*)realloc(r->scratch, cap);
+    r->scratch_cap = cap;
+  }
+  return r->scratch;
+}
+
+}  // namespace
+
+// Parse a serialized GetRateLimitsReq and stage it into a STACK of K
+// compact-format windows (one drain = many such calls + one stacked device
+// dispatch; see router_drain_begin).  Items spill to later windows when
+// their shard's current window is full; the per-shard cursor `kcur`
+// (caller-owned, zeroed at drain start) only moves forward, so all staging
+// for a shard — and therefore for any single key — is window-monotonic
+// across the whole drain, preserving sequential per-key semantics through
+// the device-side scan.
+//
+// Two passes: pass 1 parses, validates and hashes every item with NO side
+// effects (a fallback return leaves the router and the stack untouched —
+// no allocations, no evictions, no consumed lanes); pass 2 stages
+// unconditionally.
+//
+// packed: i64[K, S, lanes, 2] pre-zeroed; shard_fill: i32[K, S];
+// kcur: i32[S].  out_row/out_lane/out_limit: per-item demux info
+// (out_limit feeds the response encoder, which echoes the request limit —
+// see fastpath_encode_w).
+//
+// Returns the request count n >= 0, or:
 //   -1  malformed protobuf
 //   -2  a request needs the full path (behavior/algorithm/validation/range)
 //   -3  more than max_items requests
-//   -4  a shard's lanes overflowed (caller chunks via the full path)
-//   -5  a key routed to a shard this process does not own (mesh mode)
-int64_t fastpath_parse(Router* r, const uint8_t* buf, int64_t len,
-                       int64_t now, int32_t lanes, int64_t max_items,
-                       int64_t* packed, int32_t* out_shard,
-                       int32_t* out_lane, int32_t* shard_fill) {
-  r->pack_seq++;
-  r->commit_len = 0;
+//   -6  the RPC does not fit in this stack's remaining lanes (caller
+//       dispatches the stack and retries on a fresh one; -6 on a FRESH
+//       stack means the RPC can never fit and must take the full path)
+int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
+                             int64_t now, int32_t lanes, int32_t K,
+                             int64_t max_items, int64_t* packed,
+                             int32_t* kcur, int32_t* shard_fill,
+                             int32_t* out_row, int32_t* out_lane,
+                             int64_t* out_limit) {
+  int32_t S = r->num_shards;
+  if (S > MAX_STACK_SHARDS) return -2;
+  if (max_items > MAX_STACK_ITEMS) max_items = MAX_STACK_ITEMS;
+  static thread_local ParsedItem items[MAX_STACK_ITEMS];
+  int64_t demand[MAX_STACK_SHARDS] = {0};
+
+  // ---- pass 1: parse + validate + hash, no side effects ----
   const uint8_t* p = buf;
   const uint8_t* end = buf + len;
   int64_t n = 0;
+  int64_t scratch_need = 0;
   while (p < end) {
     uint64_t tag;
     if (!read_varint(&p, end, &tag)) return -1;
     if (tag != ((1u << 3) | 2)) {  // only field 1: repeated RateLimitReq
-      // skip unknown top-level field
       int wt = (int)(tag & 7);
       if (wt == 0) {
         uint64_t dummy;
@@ -533,103 +780,135 @@ int64_t fastpath_parse(Router* r, const uint8_t* buf, int64_t len,
     uint64_t mlen;
     if (!read_varint(&p, end, &mlen) || mlen > (uint64_t)(end - p))
       return -1;
-    const uint8_t* q = p;
-    const uint8_t* qend = p + mlen;
-    p = qend;
-
     if (n >= max_items) return -3;
+    ParsedItem* it = &items[n];
+    uint64_t behavior;
+    if (!parse_item(p, p + mlen, it, &behavior)) return -1;
+    p += mlen;
 
-    const uint8_t* name = nullptr;
-    int64_t name_len = 0;
-    const uint8_t* key = nullptr;
-    int64_t key_len = 0;
-    int64_t hits = 0, limit = 0, duration = 0;
-    uint64_t algo = 0, behavior = 0;
-    while (q < qend) {
-      uint64_t t;
-      if (!read_varint(&q, qend, &t)) return -1;
-      uint64_t field = t >> 3;
-      int wt = (int)(t & 7);
-      if (wt == 2) {
-        uint64_t l;
-        if (!read_varint(&q, qend, &l) || l > (uint64_t)(qend - q))
-          return -1;
-        if (field == 1) {
-          name = q;
-          name_len = (int64_t)l;
-        } else if (field == 2) {
-          key = q;
-          key_len = (int64_t)l;
-        }
-        q += l;
-      } else if (wt == 0) {
-        uint64_t v;
-        if (!read_varint(&q, qend, &v)) return -1;
-        if (field == 3) hits = (int64_t)v;
-        else if (field == 4) limit = (int64_t)v;
-        else if (field == 5) duration = (int64_t)v;
-        else if (field == 6) algo = v;
-        else if (field == 7) behavior = v;
-      } else {
-        return -1;
-      }
-    }
-
-    if (name_len == 0 || key_len == 0) return -2;  // per-item error path
-    if (behavior != 0) return -2;                  // BATCHING only
-    if (algo > 1) return -2;                       // invalid algorithm
-    if (hits < 0 || hits >= COMPACT_MAX_HITS) return -2;
-    if (limit < 0 || limit >= COMPACT_MAX_LIMIT) return -2;
-    if (duration < 0 || duration >= COMPACT_MAX_DURATION) return -2;
+    if (it->name_len == 0 || it->key_len == 0) return -2;
+    if (behavior != 0) return -2;  // BATCHING only
+    if (it->algo > 1) return -2;
+    if (it->hits < 0 || it->hits >= COMPACT_MAX_HITS) return -2;
+    if (it->limit < 0 || it->limit >= COMPACT_MAX_LIMIT) return -2;
+    if (it->duration < 0 || it->duration >= COMPACT_MAX_DURATION) return -2;
 
     // hash key = name + "_" + unique_key (client.go:33-35), streamed
-    uint32_t c = 0xFFFFFFFFu;
-    c = crc32_update(c, name, name_len);
     uint8_t sep = '_';
+    uint32_t c = 0xFFFFFFFFu;
+    c = crc32_update(c, it->name, it->name_len);
     c = crc32_update(c, &sep, 1);
-    c = crc32_update(c, key, key_len);
+    c = crc32_update(c, it->key, it->key_len);
     uint32_t crc = c ^ 0xFFFFFFFFu;
-    uint64_t fp = fnv1a_update(1469598103934665603ull, name, name_len);
+    uint64_t fp = fnv1a_update(1469598103934665603ull, it->name,
+                               it->name_len);
     fp = fnv1a_update(fp, &sep, 1);
-    fp = fnv1a_update(fp, key, key_len);
-    if (!fp) fp = 1;
+    fp = fnv1a_update(fp, it->key, it->key_len);
+    it->fp = fp ? fp : 1;
 
     int32_t shard = (int32_t)(crc % (uint32_t)r->num_global_shards) -
                     r->shard_offset;
-    if (shard < 0 || shard >= r->num_shards) return -5;
-    int32_t lane = shard_fill[shard];
-    if (lane >= lanes) return -4;
-    uint8_t is_init = 0;
-    int32_t slot = shard_lookup(&r->shards[shard], fp, now, duration,
-                                r->pack_seq, &is_init);
-    if (is_init) push_commit(r, shard, slot);
-
-    int64_t o = ((int64_t)shard * lanes + lane) * 2;
-    packed[o] = (int64_t)(slot + 1) | ((int64_t)is_init << 32) |
-                ((int64_t)algo << 33) | (hits << 34);
-    packed[o + 1] = limit | (duration << 32);
-    out_shard[n] = shard;
-    out_lane[n] = lane;
-    shard_fill[shard] = lane + 1;
+    if (shard < 0 || shard >= S) return -2;  // not ours: full path routes it
+    it->shard = shard;
+    demand[shard]++;
+    if (r->exact) {
+      it->scratch_off = scratch_need;
+      scratch_need += it->name_len + 1 + it->key_len;
+    }
     n++;
+  }
+  if (!stack_fits(demand, kcur, shard_fill, S, lanes, K)) return -6;
+
+  // ---- pass 2: stage (cannot fail) ----
+  uint8_t* scratch = r->exact ? scratch_reserve(r, scratch_need) : nullptr;
+  for (int64_t i = 0; i < n; i++) {
+    ParsedItem* it = &items[i];
+    const uint8_t* kb = nullptr;
+    int64_t kl = 0;
+    if (r->exact) {
+      kb = scratch + it->scratch_off;
+      uint8_t* w = scratch + it->scratch_off;
+      memcpy(w, it->name, it->name_len);
+      w[it->name_len] = '_';
+      memcpy(w + it->name_len + 1, it->key, it->key_len);
+      kl = it->name_len + 1 + it->key_len;
+    }
+    stage_lane(r, it->shard, it->fp, kb, kl, now, it->hits, it->limit,
+               it->duration, it->algo, lanes, K, packed, kcur, shard_fill,
+               out_row, out_lane, i);
+    out_limit[i] = it->limit;
   }
   return n;
 }
 
-// Encode the fetched compact response (cword = i64[num_local_shards, lanes,
-// 2]) as a serialized GetRateLimitsResp for the n requests at
-// (out_shard[i], out_lane[i]).  Returns the byte length, or -1 if out_cap
-// is too small.
-int64_t fastpath_encode(const int64_t* cword, int64_t now, int32_t lanes,
-                        int64_t n, const int32_t* out_shard,
-                        const int32_t* out_lane, uint8_t* out,
-                        int64_t out_cap) {
+// Columnar-input sibling of fastpath_parse_stack for already-parsed request
+// lists (the batcher's Python-side jobs).  Same drain protocol, same
+// monotonic spill, same no-side-effects-on-fallback guarantee.
+// Returns n >= 0, or -2 (a value outside the compact ranges: caller routes
+// the job through the full-format path), -3 (too many items), -5 (a key
+// routed to a shard this process does not own), -6 (stack full).
+int64_t router_pack_stack(Router* r, const uint8_t* key_bytes,
+                          const int64_t* key_ends, int64_t n,
+                          const int64_t* hits, const int64_t* limits,
+                          const int64_t* durations, const int32_t* algos,
+                          int64_t now, int32_t lanes, int32_t K,
+                          int64_t* packed, int32_t* kcur,
+                          int32_t* shard_fill, int32_t* out_row,
+                          int32_t* out_lane) {
+  int32_t S = r->num_shards;
+  if (S > MAX_STACK_SHARDS) return -2;
+  if (n > MAX_STACK_ITEMS) return -3;
+  static thread_local uint64_t fps[MAX_STACK_ITEMS];
+  static thread_local int32_t shards[MAX_STACK_ITEMS];
+  int64_t demand[MAX_STACK_SHARDS] = {0};
+
+  for (int64_t i = 0; i < n; i++) {
+    if (hits[i] < 0 || hits[i] >= COMPACT_MAX_HITS) return -2;
+    if (limits[i] < 0 || limits[i] >= COMPACT_MAX_LIMIT) return -2;
+    if (durations[i] < 0 || durations[i] >= COMPACT_MAX_DURATION) return -2;
+    if (algos[i] < 0 || algos[i] > 1) return -2;
+    int64_t beg = i == 0 ? 0 : key_ends[i - 1];
+    int64_t len = key_ends[i] - beg;
+    const uint8_t* key = key_bytes + beg;
+    int32_t shard = (int32_t)(crc32(key, len) %
+                              (uint32_t)r->num_global_shards) -
+                    r->shard_offset;
+    if (shard < 0 || shard >= S) return -5;
+    shards[i] = shard;
+    fps[i] = fnv1a64(key, len);
+    demand[shard]++;
+  }
+  if (!stack_fits(demand, kcur, shard_fill, S, lanes, K)) return -6;
+
+  for (int64_t i = 0; i < n; i++) {
+    int64_t beg = i == 0 ? 0 : key_ends[i - 1];
+    stage_lane(r, shards[i], fps[i], key_bytes + beg, key_ends[i] - beg,
+               now, hits[i], limits[i], durations[i], (uint32_t)algos[i],
+               lanes, K, packed, kcur, shard_fill, out_row, out_lane, i);
+  }
+  return n;
+}
+
+// Encode the fetched response-word plane (w0 = i64[K*S, lanes], the packed
+// status/remaining/reset word — see ops/kernel.py encode_output_word) as a
+// serialized GetRateLimitsResp for the n requests at
+// (out_row[i], out_lane[i]).  The response's `limit` field echoes the
+// REQUEST limit (item_limit, captured at parse time) — stored-vs-request
+// limit mismatches are rare (a config change on a live bucket), so the
+// device ships the full limit plane only when its per-window mismatch flag
+// fires, and `climit` is non-null only then.
+// Returns the byte length, or -1 if out_cap is too small.
+int64_t fastpath_encode_w(const int64_t* w0, const int64_t* item_limit,
+                          int64_t now, int32_t lanes, int64_t n,
+                          const int32_t* out_row, const int32_t* out_lane,
+                          const int64_t* climit, uint8_t* out,
+                          int64_t out_cap) {
   uint8_t* w = out;
   uint8_t* wend = out + out_cap;
   for (int64_t i = 0; i < n; i++) {
-    int64_t o = ((int64_t)out_shard[i] * lanes + out_lane[i]) * 2;
-    int64_t word = cword[o];
-    int64_t limit = cword[o + 1];
+    int64_t o = (int64_t)out_row[i] * lanes + out_lane[i];
+    int64_t word = w0[o];
+    int64_t limit = climit ? climit[o] : item_limit[i];
     int64_t remaining = word & 0x7FFFFFFFll;
     int64_t status = (word >> 31) & 1;
     int64_t enc = (word >> 32) & 0xFFFFFFFFll;

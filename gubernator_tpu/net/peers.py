@@ -67,6 +67,26 @@ class PeerClient:
         ])
         await self.stub.UpdatePeerGlobals(msg, timeout=self.conf.global_timeout)
 
+    async def register_globals(self, specs: List[tuple]) -> None:
+        """Forward (key, limit, duration, algorithm) registrations to the
+        mesh registrar (api/proto/peers.proto RegisterGlobals)."""
+        msg = pb.RegisterGlobalsReq(specs=[
+            pb.GlobalSpec(key=k, limit=lim, duration=dur, algorithm=int(a))
+            for (k, lim, dur, a) in specs
+        ])
+        await self.stub.RegisterGlobals(msg, timeout=self.conf.global_timeout)
+
+    async def apply_global_registration(self, specs: List[tuple], now: int,
+                                        activate: bool) -> None:
+        """Registrar-side fan-out of one registration phase."""
+        msg = pb.ApplyGlobalRegistrationReq(
+            specs=[pb.GlobalSpec(key=k, limit=lim, duration=dur,
+                                 algorithm=int(a))
+                   for (k, lim, dur, a) in specs],
+            now=now, activate=activate)
+        await self.stub.ApplyGlobalRegistration(
+            msg, timeout=self.conf.global_timeout)
+
     # -------------------------------------------------------------- batching
 
     async def _batched(self, req: RateLimitReq) -> RateLimitResp:

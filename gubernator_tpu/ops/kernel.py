@@ -534,17 +534,26 @@ def encode_batch_host(slot, hits, limit, duration, algo, is_init):
     return np.stack([w0, w1], axis=-1)
 
 
-def encode_output_compact(out: WindowOutput, now) -> jax.Array:
-    """Device-side encode of responses into i64[B, 2] (packed word, limit)."""
+def encode_output_word(out: WindowOutput, now) -> jax.Array:
+    """Device-side encode of (status, remaining, reset_time) into one i64
+    word per lane.  The response's limit travels separately: the serving
+    pipeline echoes the REQUEST limit host-side and fetches the device's
+    limit plane only when a window's stored-vs-request mismatch flag fires
+    (see engine._compiled_pipeline_step) — on hit paths the two differ only
+    when a live bucket's config was changed mid-stream."""
     reset_enc = jnp.where(
         out.reset_time == 0,
         jnp.int64(0),
         jnp.clip(out.reset_time - now, 0, (1 << 31) - 2) + 1,
     )
-    word = ((reset_enc << 32)
+    return ((reset_enc << 32)
             | (out.status.astype(I64) << 31)
             | jnp.clip(out.remaining, 0, (1 << 31) - 1))
-    return jnp.stack([word, out.limit], axis=-1)
+
+
+def encode_output_compact(out: WindowOutput, now) -> jax.Array:
+    """Device-side encode of responses into i64[B, 2] (packed word, limit)."""
+    return jnp.stack([encode_output_word(out, now), out.limit], axis=-1)
 
 
 def decode_output_host(packed, now) -> WindowOutput:

@@ -8,7 +8,6 @@ reference's stats-handler pipeline (prometheus.go:104-145).
 
 from __future__ import annotations
 
-import asyncio
 import time
 from typing import Optional
 
@@ -16,31 +15,31 @@ import grpc
 
 from gubernator_tpu.api import pb
 from gubernator_tpu.api.grpc_api import add_peers_servicer, add_v1_servicer
-from gubernator_tpu.api.types import millisecond_now
-from gubernator_tpu.core.fastpath import FastPath
 from gubernator_tpu.core.service import BatchTooLargeError, Instance
 
-# Only RPCs at least this large take the immediate fast path; smaller ones
-# keep the batching window so many tiny concurrent RPCs aggregate into one
-# dispatch (the reference's BATCHING default, peers.go:143-172).  ~32B/item
-# on the wire, so this is roughly a 64-item batch.
+# Only RPCs at least this large take the native pipeline RPC lane; smaller
+# ones go through the per-item path, whose requests aggregate with
+# everything else pending in the next pipeline drain anyway (the reference's
+# BATCHING default, peers.go:143-172).  ~32B/item on the wire, so this is
+# roughly a 64-item batch.
 FASTPATH_MIN_BYTES = 2048
 
 
 class _V1Servicer:
     def __init__(self, instance: Instance):
         self.instance = instance
-        self.fastpath = FastPath(instance.engine)
 
     async def GetRateLimits(self, data: bytes, context):
         inst = self.instance
         m = inst.metrics
         start = time.monotonic()
-        if (self.fastpath.enabled and len(data) >= FASTPATH_MIN_BYTES
-                and not inst.mesh_mode and inst._picker.size() == 0):
-            out = await asyncio.get_running_loop().run_in_executor(
-                inst.batcher._executor,
-                self.fastpath.handle, data, millisecond_now())
+        if inst.standalone and len(data) >= FASTPATH_MIN_BYTES:
+            # native RPC lane: C parse -> stacked compact dispatch -> C
+            # encode (core/pipeline.py); the drain re-checks standalone-ness
+            # on the engine thread, so a membership change that races this
+            # RPC falls back to the full path below instead of deciding
+            # keys this node no longer owns
+            out = await inst.batcher.submit_rpc(data)
             if out is not None:
                 m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start,
                               ok=True)
@@ -88,6 +87,37 @@ class _PeersServicer:
         m.observe_rpc("/pb.gubernator.PeersV1/GetPeerRateLimits", start, ok=True)
         return pb.GetPeerRateLimitsResp(
             rate_limits=[pb.resp_to_pb(r) for r in resps])
+
+    async def RegisterGlobals(self, request, context):
+        start = time.monotonic()
+        m = self.instance.metrics
+        specs = [(s.key, s.limit, s.duration, int(s.algorithm))
+                 for s in request.specs]
+        try:
+            await self.instance.register_globals(specs)
+        except Exception as e:
+            m.observe_rpc("/pb.gubernator.PeersV1/RegisterGlobals", start,
+                          ok=False)
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        m.observe_rpc("/pb.gubernator.PeersV1/RegisterGlobals", start,
+                      ok=True)
+        return pb.RegisterGlobalsResp()
+
+    async def ApplyGlobalRegistration(self, request, context):
+        start = time.monotonic()
+        m = self.instance.metrics
+        specs = [(s.key, s.limit, s.duration, int(s.algorithm))
+                 for s in request.specs]
+        try:
+            await self.instance.apply_global_registration(
+                specs, request.now, request.activate)
+        except Exception as e:
+            m.observe_rpc("/pb.gubernator.PeersV1/ApplyGlobalRegistration",
+                          start, ok=False)
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        m.observe_rpc("/pb.gubernator.PeersV1/ApplyGlobalRegistration",
+                      start, ok=True)
+        return pb.ApplyGlobalRegistrationResp()
 
     async def UpdatePeerGlobals(self, request, context):
         from gubernator_tpu.api.types import UpdatePeerGlobal

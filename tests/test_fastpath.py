@@ -1,10 +1,14 @@
-"""Native fast serving path: differential vs the full Python path.
+"""Pipelined native serving path: differential vs the full Python path.
 
-The fast path (core/fastpath.py + host_router.cc fastpath_parse/encode)
-must produce byte-level GetRateLimitsResp content identical to what the
-slow path computes for the same requests, and must REFUSE (fall back)
-whenever a request needs semantics it doesn't implement.
+The pipeline (core/pipeline.py + host_router.cc fastpath_parse_stack /
+router_pack_stack / fastpath_encode_w) must produce responses identical to
+the full Python path for the same requests, must REFUSE (fall back)
+whenever a request needs semantics it doesn't implement, and — per the
+pre-scan design — must leave the router completely untouched when it
+refuses an RPC.
 """
+
+import asyncio
 
 import numpy as np
 import pytest
@@ -13,8 +17,9 @@ import gubernator_tpu  # noqa: F401
 from gubernator_tpu import native
 from gubernator_tpu.api import pb
 from gubernator_tpu.api.types import Algorithm, Behavior, RateLimitReq
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.core.batcher import WindowBatcher
 from gubernator_tpu.core.engine import RateLimitEngine
-from gubernator_tpu.core.fastpath import FastPath
 
 pytestmark = pytest.mark.skipif(not native.available(),
                                 reason="native router unavailable")
@@ -30,109 +35,280 @@ def _mk(items):
     ]).SerializeToString()
 
 
-def _engine(use_native):
-    return RateLimitEngine(capacity_per_shard=256, batch_per_shard=64,
+def _engine(use_native, lanes=64):
+    return RateLimitEngine(capacity_per_shard=256, batch_per_shard=lanes,
                            global_capacity=16, global_batch_per_shard=8,
                            max_global_updates=8, use_native=use_native)
 
 
-def test_fastpath_matches_python_path():
-    fast_eng = _engine("on")
-    ref_eng = _engine(False)
-    fp = FastPath(fast_eng)
-    assert fp.enabled
+def _batcher(eng, now=T0):
+    b = WindowBatcher(eng, BehaviorConfig())
+    assert b.pipeline is not None and b.pipeline.enabled
+    b.pipeline.now_fn = lambda: now
+    return b
 
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _check(got, want, tag=""):
+    assert len(got) == len(want)
+    for j, (g, r) in enumerate(zip(got, want)):
+        assert (int(g.status), g.limit, g.remaining, g.reset_time) == \
+            (int(r.status), r.limit, r.remaining, r.reset_time), (tag, j)
+
+
+def test_pipeline_singles_match_python_path():
+    eng = _engine("on")
+    ref = _engine(False)
     rng = np.random.default_rng(3)
-    for w in range(6):
+    for w in range(4):
         now = T0 + w * 250
-        items = []
-        for i in range(40):
-            key = f"k{rng.integers(0, 25)}"  # hot duplicates in-window
-            algo = int(rng.integers(0, 2))
-            hits = int(rng.integers(0, 4))
-            items.append(("fpd", key, hits, 10, 60_000, algo, 0))
+        b = _batcher(eng, now)
+        reqs = [
+            RateLimitReq(name="pd", unique_key=f"k{rng.integers(0, 25)}",
+                         hits=int(rng.integers(0, 4)), limit=10,
+                         duration=60_000,
+                         algorithm=int(rng.integers(0, 2)))
+            for _ in range(40)
+        ]
+
+        async def run():
+            return await asyncio.gather(*(b.submit(r) for r in reqs))
+
+        got = _run(run())
+        b.close()
+        want = ref.process(reqs, now=now)
+        _check(got, want, w)
+
+
+def test_pipeline_rpc_bytes_match_python_path():
+    eng = _engine("on")
+    ref = _engine(False)
+    rng = np.random.default_rng(5)
+    for w in range(4):
+        now = T0 + w * 300
+        b = _batcher(eng, now)
+        items = [("rpc", f"k{rng.integers(0, 20)}", int(rng.integers(0, 3)),
+                  10, 60_000, int(rng.integers(0, 2)), 0)
+                 for _ in range(50)]
         data = _mk(items)
-        out = fp.handle(data, now)
+        out = _run(b.submit_rpc(data))
+        b.close()
         assert out is not None
-        got = pb.GetRateLimitsResp.FromString(out)
-        want = ref_eng.process(
+        got = pb.GetRateLimitsResp.FromString(out).responses
+        want = ref.process(
             [RateLimitReq(name=n, unique_key=k, hits=h, limit=l, duration=d,
                           algorithm=a) for (n, k, h, l, d, a, _) in items],
             now=now)
-        assert len(got.responses) == len(want)
-        for j, (g, r) in enumerate(zip(got.responses, want)):
-            assert (g.status, g.limit, g.remaining, g.reset_time) == \
-                (int(r.status), r.limit, r.remaining, r.reset_time), (w, j)
+        _check(got, want, w)
 
 
-def test_fastpath_expiry_and_leaky_over_time():
-    fast_eng = _engine("on")
-    ref_eng = _engine(False)
-    fp = FastPath(fast_eng)
-    items = [("fpe", "x", 1, 3, 100, 1, 0)]  # leaky, 100ms duration
-    data = _mk(items)
-    req = [RateLimitReq(name="fpe", unique_key="x", hits=1, limit=3,
-                        duration=100, algorithm=Algorithm.LEAKY_BUCKET)]
-    for dt in (0, 10, 35, 36, 37, 500):  # leak steps + full expiry
-        now = T0 + dt
-        g = pb.GetRateLimitsResp.FromString(fp.handle(data, now)).responses[0]
-        r = ref_eng.process(req, now=now)[0]
-        assert (g.status, g.remaining, g.reset_time) == \
-            (int(r.status), r.remaining, r.reset_time), dt
-
-
-def test_fastpath_fallback_codes():
+def test_pipeline_mixed_jobs_one_drain():
+    """Singles, a list batch, and raw RPC bytes submitted concurrently must
+    coalesce without corrupting each other's demux or per-key ordering."""
     eng = _engine("on")
-    fp = FastPath(eng)
+    ref = _engine(False)
+    b = _batcher(eng)
+    singles = [RateLimitReq(name="mx", unique_key=f"s{i % 7}", hits=1,
+                            limit=100, duration=60_000) for i in range(20)]
+    batch = [RateLimitReq(name="mx", unique_key=f"b{i % 5}", hits=2,
+                          limit=50, duration=60_000, algorithm=1)
+             for i in range(15)]
+    rpc_items = [("mx", f"s{i % 7}", 1, 100, 60_000, 0, 0)
+                 for i in range(10)]
+
+    async def run():
+        t1 = [b.submit(r) for r in singles]
+        t2 = b.submit_now(batch)
+        t3 = b.submit_rpc(_mk(rpc_items))
+        return await asyncio.gather(asyncio.gather(*t1), t2, t3)
+
+    got_singles, got_batch, got_rpc = _run(run())
+    b.close()
+    # replay the identical global order on the reference engine
+    want = ref.process(singles + batch, now=T0)
+    want_rpc = ref.process(
+        [RateLimitReq(name=n, unique_key=k, hits=h, limit=l, duration=d,
+                      algorithm=a) for (n, k, h, l, d, a, _) in rpc_items],
+        now=T0)
+    _check(got_singles, want[:20], "singles")
+    _check(got_batch, want[20:], "batch")
+    _check(pb.GetRateLimitsResp.FromString(got_rpc).responses, want_rpc,
+           "rpc")
+
+
+def test_pipeline_rpc_spills_across_windows():
+    """An RPC bigger than one window's lanes spreads over the stack with
+    per-key order preserved (including hot duplicate keys)."""
+    eng = _engine("on", lanes=16)  # 8 shards x 16 lanes per window
+    ref = _engine(False, lanes=16)
+    b = _batcher(eng)
+    items = [("sp", f"k{i % 40}", 1, 30, 60_000, i % 2, 0)
+             for i in range(300)]
+    out = _run(b.submit_rpc(_mk(items)))
+    b.close()
+    assert out is not None
+    got = pb.GetRateLimitsResp.FromString(out).responses
+    want = ref.process(
+        [RateLimitReq(name=n, unique_key=k, hits=h, limit=l, duration=d,
+                      algorithm=a) for (n, k, h, l, d, a, _) in items],
+        now=T0)
+    _check(got, want)
+
+
+def test_pipeline_many_rpcs_overflow_stack():
+    """More concurrent RPCs than one stack holds: leftovers ride later
+    drains; every RPC still gets exact responses."""
+    eng = _engine("on", lanes=16)
+    ref = _engine(False, lanes=16)
+    b = _batcher(eng)
+    all_items = []
+    datas = []
+    for r in range(12):
+        items = [("ov", f"r{r}k{i}", 1, 10, 60_000, 0, 0) for i in range(60)]
+        all_items.extend(items)
+        datas.append(_mk(items))
+
+    async def run():
+        return await asyncio.gather(*(b.submit_rpc(d) for d in datas))
+
+    outs = _run(run())
+    b.close()
+    assert all(o is not None for o in outs)
+    want = ref.process(
+        [RateLimitReq(name=n, unique_key=k, hits=h, limit=l, duration=d,
+                      algorithm=a) for (n, k, h, l, d, a, _) in all_items],
+        now=T0)
+    got = []
+    for o in outs:
+        got.extend(pb.GetRateLimitsResp.FromString(o).responses)
+    _check(got, want)
+
+
+def test_pipeline_stored_limit_mismatch():
+    """A live bucket whose later requests carry a different (in-range)
+    limit must answer with the STORED limit — the rare path where the
+    device's limit plane is fetched instead of echoing the request."""
+    eng = _engine("on")
+    ref = _engine(False)
+    b = _batcher(eng)
+    first = RateLimitReq(name="lm", unique_key="x", hits=1, limit=10,
+                         duration=60_000)
+    second = RateLimitReq(name="lm", unique_key="x", hits=1, limit=25,
+                          duration=60_000)
+
+    async def run():
+        r1 = await b.submit(first)
+        r2 = await b.submit(second)
+        return r1, r2
+
+    got = _run(run())
+    b.close()
+    want = ref.process([first, second], now=T0)
+    _check(got, want)
+    assert got[1].limit == 10  # stored config wins on the hit path
+
+
+def test_pipeline_rpc_fallback_codes():
+    eng = _engine("on")
+    b = _batcher(eng)
     now = T0
+
+    async def fb(data):
+        return await b.submit_rpc(data)
+
+    size0 = eng.native.size
+    w0 = eng.windows_processed
     # GLOBAL behavior -> full path
-    assert fp.handle(_mk([("f", "k", 1, 5, 1000, 0, int(Behavior.GLOBAL))]),
-                     now) is None
+    assert _run(fb(_mk([("f", "k", 1, 5, 1000, 0,
+                         int(Behavior.GLOBAL))]))) is None
     # empty unique_key -> full path (per-item error semantics)
-    assert fp.handle(_mk([("f", "", 1, 5, 1000, 0, 0)]), now) is None
+    assert _run(fb(_mk([("f", "", 1, 5, 1000, 0, 0)]))) is None
     # empty name -> full path
-    assert fp.handle(_mk([("", "k", 1, 5, 1000, 0, 0)]), now) is None
+    assert _run(fb(_mk([("", "k", 1, 5, 1000, 0, 0)]))) is None
     # invalid algorithm -> full path
-    assert fp.handle(_mk([("f", "k", 1, 5, 1000, 7, 0)]), now) is None
+    assert _run(fb(_mk([("f", "k", 1, 5, 1000, 7, 0)]))) is None
     # out-of-compact-range limit -> full path
-    assert fp.handle(_mk([("f", "k", 1, 1 << 40, 1000, 0, 0)]), now) is None
+    assert _run(fb(_mk([("f", "k", 1, 1 << 40, 1000, 0, 0)]))) is None
     # negative hits (encodes as 10-byte varint) -> full path
-    assert fp.handle(_mk([("f", "k", -1, 5, 1000, 0, 0)]), now) is None
+    assert _run(fb(_mk([("f", "k", -1, 5, 1000, 0, 0)]))) is None
     # malformed bytes -> full path
-    assert fp.handle(b"\x0a\xff\xff\xff", now) is None
-    # nothing above may have dispatched or mutated counters
-    assert eng.windows_processed == 0
+    assert _run(fb(b"\x0a\xff\xff\xff")) is None
+    # a valid item FOLLOWED by an invalid one: the pre-scan must refuse the
+    # whole RPC before staging anything
+    assert _run(fb(_mk([("f", "good", 1, 5, 1000, 0, 0),
+                        ("f", "", 1, 5, 1000, 0, 0)]))) is None
+    b.close()
+    # nothing above may have dispatched, allocated, or evicted
+    assert eng.windows_processed == w0
+    assert eng.native.size == size0
 
 
-def test_fastpath_lane_overflow_falls_back():
+def test_pipeline_rpc_gate_follows_membership():
     eng = _engine("on")
-    fp = FastPath(eng)
-    # 600 distinct keys over 8 shards x 64 lanes: some shard must overflow
-    items = [("fov", f"k{i}", 1, 10, 1000, 0, 0) for i in range(600)]
-    assert fp.handle(_mk(items), T0) is None
-    assert eng.windows_processed == 0
+    b = _batcher(eng)
+    b.pipeline.rpc_enabled = False  # what Instance.set_peers does on join
+    assert _run(b.submit_rpc(_mk([("g", "k", 1, 5, 1000, 0, 0)]))) is None
+    b.close()
 
 
-def test_fastpath_interleaves_with_slow_path():
-    """Fast-path windows and engine.process windows share the same arena and
-    router; interleaving them must stay consistent."""
-    fast_eng = _engine("on")
-    ref_eng = _engine(False)
-    fp = FastPath(fast_eng)
-    req = [RateLimitReq(name="fi", unique_key="k", hits=1, limit=5,
-                        duration=60_000)]
-    data = _mk([("fi", "k", 1, 5, 60_000, 0, 0)])
-    seq_fast = []
-    seq_ref = []
+def test_pipeline_list_fallback_routes_legacy():
+    """An out-of-range (but valid) request list must fall back to the full
+    path and still produce exact answers."""
+    eng = _engine("on")
+    ref = _engine(False)
+    b = _batcher(eng)
+    reqs = [RateLimitReq(name="lf", unique_key="big", hits=1,
+                         limit=1 << 40, duration=60_000)]
+
+    async def run():
+        return await b.submit_now(reqs)
+
+    got = _run(run())
+    b.close()
+    want = ref.process(reqs, now=T0)
+    # full path went through engine.process with wall-clock now; compare
+    # status/remaining only (reset_time depends on the uncontrolled now)
+    assert [(int(g.status), g.remaining) for g in got] == \
+        [(int(r.status), r.remaining) for r in want]
+
+
+def test_pipeline_interleaves_with_legacy_path():
+    """Pipeline drains and legacy step windows share the arena and router;
+    interleaving them must stay consistent."""
+    eng = _engine("on")
+    ref = _engine(False)
+    seq_got, seq_want = [], []
+    req = RateLimitReq(name="il", unique_key="k", hits=1, limit=5,
+                       duration=60_000)
     for i in range(6):
         now = T0 + i
         if i % 2 == 0:
-            g = pb.GetRateLimitsResp.FromString(
-                fp.handle(data, now)).responses[0]
-            seq_fast.append((g.status, g.remaining))
+            b = _batcher(eng, now)
+            r = _run(b.submit(req))
+            b.close()
         else:
-            r = fast_eng.process(req, now=now)[0]
-            seq_fast.append((int(r.status), r.remaining))
-        r = ref_eng.process(req, now=now)[0]
-        seq_ref.append((int(r.status), r.remaining))
-    assert seq_fast == seq_ref
+            r = eng.process([req], now=now)[0]
+        seq_got.append((int(r.status), r.remaining))
+        r = ref.process([req], now=now)[0]
+        seq_want.append((int(r.status), r.remaining))
+    assert seq_got == seq_want
+
+
+def test_pipeline_expiry_and_leaky_over_time():
+    eng = _engine("on")
+    ref = _engine(False)
+    req = [RateLimitReq(name="fpe", unique_key="x", hits=1, limit=3,
+                        duration=100, algorithm=Algorithm.LEAKY_BUCKET)]
+    data = _mk([("fpe", "x", 1, 3, 100, 1, 0)])
+    for dt in (0, 10, 35, 36, 37, 500):  # leak steps + full expiry
+        now = T0 + dt
+        b = _batcher(eng, now)
+        out = _run(b.submit_rpc(data))
+        b.close()
+        g = pb.GetRateLimitsResp.FromString(out).responses[0]
+        r = ref.process(req, now=now)[0]
+        assert (g.status, g.remaining, g.reset_time) == \
+            (int(r.status), r.remaining, r.reset_time), dt

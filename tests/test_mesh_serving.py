@@ -33,7 +33,7 @@ def _free_ports(n):
     return ports
 
 
-def _child(pid, coord_port, grpc0, grpc1, ctrl_port):
+def _child(pid, coord_port, grpc0, grpc1, ctrl_port, stack=1):
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     os.environ["GUBER_MESH_COORDINATOR"] = f"127.0.0.1:{coord_port}"
@@ -68,7 +68,8 @@ def _child(pid, coord_port, grpc0, grpc1, ctrl_port):
     async def main():
         inst = Instance(
             Config(
-                behaviors=BehaviorConfig(batch_wait=0.05),
+                behaviors=BehaviorConfig(batch_wait=0.05,
+                                         lockstep_stack=stack),
                 engine=EngineConfig(
                     capacity_per_shard=64, batch_per_shard=16,
                     global_capacity=16, global_batch_per_shard=8,
@@ -79,7 +80,7 @@ def _child(pid, coord_port, grpc0, grpc1, ctrl_port):
             mesh_peers=addrs,
         )
         epoch = inst.batcher.clock.epoch_ms
-        inst.engine.warmup(now=epoch)
+        inst.engine.warmup(now=epoch, k_stack=stack)
         inst.engine.register_global_keys(
             [("msrv_gbl_g", 100, 60_000, Algorithm.TOKEN_BUCKET)], now=epoch)
 
@@ -101,16 +102,16 @@ def _child(pid, coord_port, grpc0, grpc1, ctrl_port):
                 while True:
                     line = (await reader.readline()).decode().strip()
                     if line.startswith("CHECK"):
-                        _, expect = line.split()
+                        _, name, key, limit, expect = line.split()
                         probe = RateLimitReq(
-                            name="msrv_gbl", unique_key="g", hits=0,
-                            limit=100, duration=60_000,
+                            name=name, unique_key=key, hits=0,
+                            limit=int(limit), duration=60_000,
                             behavior=Behavior.GLOBAL)
                         client = AsyncClient(me)
                         r = (await client.get_rate_limits([probe]))[0]
-                        ok = r.remaining == int(expect)
+                        ok = r.remaining == int(expect) and not r.error
                         writer.write(
-                            f"{'OK' if ok else f'BAD {r.remaining}'}\n".encode())
+                            f"{'OK' if ok else f'BAD {r}'}\n".encode())
                         await writer.drain()
                     elif line.startswith("STOP"):
                         _, t = line.split()
@@ -169,10 +170,24 @@ def _child(pid, coord_port, grpc0, grpc1, ctrl_port):
         r = (await client.get_rate_limits([g]))[0]
         assert not r.error, r.error
         await asyncio.sleep(0.5)  # a few ticks: psum applies the hits
-        writer.write(b"CHECK 98\n")
+        writer.write(b"CHECK msrv_gbl g 100 98\n")
         await writer.drain()
         resp = (await reader.readline()).decode().strip()
         assert resp == "OK", f"B's replica disagrees: {resp}"
+
+        # DYNAMIC GLOBAL: a key never pre-registered anywhere — first use
+        # routes through the registrar's two-phase flow and then serves,
+        # and the hits become visible on B purely via the psum
+        dg = RateLimitReq(name="msrv_dyn", unique_key="d", hits=3, limit=50,
+                          duration=60_000, behavior=Behavior.GLOBAL)
+        r = (await client.get_rate_limits([dg]))[0]
+        assert not r.error, r.error
+        assert r.remaining == 47, r
+        await asyncio.sleep(0.5)
+        writer.write(b"CHECK msrv_dyn d 50 47\n")
+        await writer.drain()
+        resp = (await reader.readline()).decode().strip()
+        assert resp == "OK", f"B's dynamic-global replica disagrees: {resp}"
 
         stop_tick = inst.batcher.clock.tick + 40
         writer.write(f"STOP {stop_tick}\n".encode())
@@ -187,14 +202,20 @@ def _child(pid, coord_port, grpc0, grpc1, ctrl_port):
     asyncio.run(main())
 
 
-def test_mesh_serving_two_nodes():
+import pytest  # noqa: E402
+
+
+@pytest.mark.parametrize("stack", [1, 2])
+def test_mesh_serving_two_nodes(stack):
+    """stack=2 drives the stacked lockstep tick (engine.step_stacked): two
+    windows per collective dispatch on the cluster clock."""
     coord, grpc0, grpc1, ctrl = _free_ports(4)
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     procs = [
         subprocess.Popen(
             [sys.executable, __file__, "CHILD",
-             json.dumps([i, coord, grpc0, grpc1, ctrl])],
+             json.dumps([i, coord, grpc0, grpc1, ctrl, stack])],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             env=env)

@@ -126,3 +126,40 @@ def test_native_engine_with_globals_and_flood():
     assert [r.remaining for r in rs[301:601]] == [3] * 300
     r2 = eng.process([g(0)], now=T0 + 5)[0]
     assert r2.remaining == 45  # psum applied 3+2
+
+
+def test_differential_exact_key_guard():
+    """The opt-in exact-key guard (EngineConfig.exact_keys /
+    GUBER_EXACT_KEYS) stores and compares full keys on every lookup; the
+    engine must behave identically to the fingerprint-only router on a
+    workload with allocation, reuse, eviction, and expiry (a real 64-bit
+    FNV collision cannot be synthesized here, but this drives the storage,
+    compare, and free/realloc paths on every probe)."""
+    mk = lambda **kw: RateLimitEngine(
+        capacity_per_shard=64, batch_per_shard=32,
+        global_capacity=32, global_batch_per_shard=16, max_global_updates=16,
+        use_native="on", **kw)
+    plain, exact = mk(), mk(exact_keys=True)
+
+    rng = random.Random(11)
+    keys = [f"xk{i}" for i in range(40)]
+    now = T0
+    for w in range(20):
+        window = [
+            RateLimitReq(
+                name="exact", unique_key=rng.choice(keys),
+                hits=rng.choice([0, 1, 2]),
+                limit=rng.choice([3, 8]),
+                duration=rng.choice([5, 500]),
+                algorithm=rng.choice([Algorithm.TOKEN_BUCKET,
+                                      Algorithm.LEAKY_BUCKET]),
+            )
+            for _ in range(rng.randint(1, 25))
+        ]
+        a = plain.process(window, now=now)
+        b = exact.process(window, now=now)
+        for i, (x, y) in enumerate(zip(a, b)):
+            assert (x.status, x.limit, x.remaining, x.reset_time) == \
+                   (y.status, y.limit, y.remaining, y.reset_time), \
+                   f"window {w} item {i}"
+        now += rng.choice([0, 1, 40])
