@@ -1,89 +1,163 @@
-"""Benchmark: rate-limit decisions/sec/chip, measured at three depths.
+"""Benchmark: rate-limit decisions/sec/chip, measured at several depths.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
-The three depths (all included in the JSON; the HEADLINE value is the
-end-to-end serving number, because BASELINE.md's north star counts rate-limit
-*decisions*, which include getting a request into a lane — not just the
-device half):
+Hang-proofing: the real benchmark runs in a CHILD process with a total wall
+budget enforced by a parent that imports neither jax nor this package; if
+the child hangs (e.g. the TPU tunnel wedges mid-transfer) the parent kills
+it and still prints a parseable JSON line at rc=0 (round 2 regression: a
+25-minute rc=124 hang with no JSON).
 
-  device_decisions_per_sec   saturation path: K windows per dispatch via
-                             RateLimitEngine.step_windows (lax.scan over full
-                             serving windows), pre-packed on device.  Mixed
-                             TOKEN+LEAKY over a 1M-slot arena, Zipf(1.1) skew
-                             — the shape of BASELINE.md eval configs (2)/(3).
-  host_decisions_per_sec     engine.process(): key hashing, slot allocation,
-                             window packing (C++ router when available),
-                             device dispatch, response demux.
-  e2e_decisions_per_sec      gRPC-in → response-out on a real loopback
-                             server: proto decode, validation/routing,
-                             batching, dispatch, proto encode — the analog of
-                             the reference's full GetRateLimits path
-                             (gubernator.go:75-166).
+Tiers (each on a FRESH engine so no tier can poison another — the round-3
+bench disabled the compact wire format for every later tier by sharing one
+engine):
 
-vs_baseline compares the headline against the reference's published
+  device_decisions_per_sec   saturation: K pre-packed windows per dispatch
+                             (RateLimitEngine.step_windows), inputs resident,
+                             outputs un-fetched.  Mixed TOKEN+LEAKY over a
+                             1M-slot arena, Zipf(1.1) — the shape of
+                             BASELINE.md eval configs (2)/(3).
+  host_decisions_per_sec     the PIPELINED host path (core/pipeline.py):
+                             pre-serialized 1000-item GetRateLimitsReq bytes
+                             through C parse -> stacked compact dispatch ->
+                             C proto encode, fetches overlapped — everything
+                             the serving host does except the gRPC socket.
+  host_sync_decisions_per_sec  legacy synchronous engine.process() calls
+                             (one fetch round trip per window — the floor
+                             the pipeline exists to beat).
+  e2e_decisions_per_sec      gRPC-in -> response-out on a real loopback
+                             server (the analog of the reference's full
+                             GetRateLimits path, gubernator.go:75-166).
+  healthcheck_rtt_ms_p50     HealthCheck round trip (the reference's
+                             BenchmarkServer_Ping floor, benchmark_test.go:81).
+  thundering_herd_rps/p99    100 concurrent single-item RPC loops (the
+                             reference's BenchmarkServer_ThunderingHeard,
+                             benchmark_test.go:109).
+
+vs_baseline compares the headline (e2e) against the reference's published
 single-node throughput: >2,000 client requests/sec in production
 (README.md:94-99 — its only headline throughput number; see BASELINE.md).
 
-The TPU arrives via a tunnel that can be transiently down when the driver
-runs this; first device use retries with backoff and a permanent failure
-still emits the JSON line (with an "error" field) at rc=0 so the driver
-records a parseable result either way.
+Optional: GUBER_PROFILE=<dir> wraps the host tier in a jax.profiler trace.
 """
 
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
-import traceback
-
-import numpy as np
 
 BASELINE_REQS_PER_SEC = 2000.0
+CHILD_ENV = "GUBER_BENCH_CHILD"
+OUT_ENV = "GUBER_BENCH_OUT"
 
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def acquire_backend(attempts=10, base_delay=2.0):
-    """First device contact with retry/backoff (tunnel may be warming up).
+# --------------------------------------------------------------------- parent
 
-    Returns the device list; raises after the last attempt fails."""
-    last = None
-    for i in range(attempts):
+def parent_main():
+    """Run the real bench in a killable child under a wall budget; ALWAYS
+    print one JSON line and exit 0."""
+    budget = float(os.environ.get("GUBER_BENCH_BUDGET_S", "900"))
+    result = {
+        "metric": "rate_limit_decisions_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "decisions/s",
+        "vs_baseline": 0.0,
+    }
+    with tempfile.NamedTemporaryFile(mode="r", suffix=".json",
+                                     delete=False) as f:
+        out_path = f.name
+    env = dict(os.environ, **{CHILD_ENV: "1", OUT_ENV: out_path})
+    try:
+        proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                                env=env, stdout=sys.stderr)
         try:
-            import jax
+            proc.wait(timeout=budget)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            result["error"] = f"bench child exceeded {budget:.0f}s wall budget"
+        try:
+            with open(out_path) as f:
+                data = f.read().strip()
+            if data:
+                result.update(json.loads(data))
+            elif "error" not in result:
+                result["error"] = (
+                    f"bench child exited rc={proc.returncode} without result")
+        except Exception as e:  # noqa: BLE001
+            result.setdefault("error", f"unreadable child result: {e}")
+    except Exception as e:  # noqa: BLE001
+        result["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+    print(json.dumps(result))
 
-            # the ambient env may pin a platform at interpreter startup
-            # (sitecustomize); GUBER_BENCH_PLATFORM=cpu forces a local smoke
-            # run onto the CPU backend
-            plat = os.environ.get("GUBER_BENCH_PLATFORM")
-            if plat:
-                jax.config.update("jax_platforms", plat)
-            devs = jax.devices()
-            # force real device work so a half-up tunnel fails HERE, not
-            # mid-benchmark
-            jax.block_until_ready(jax.numpy.zeros((8,)) + 1)
-            return devs
+
+# --------------------------------------------------------------------- child
+
+def acquire_backend(attempts=5, probe_timeout=75.0):
+    """First device contact, hang-proof: each attempt PROBES the backend in
+    a killable subprocess with its own timeout first (a wedged tunnel hangs
+    `jax.devices()` indefinitely and uninterruptibly — round-2/4 bench
+    history — and killing the probing process is also what nudges the
+    tunnel to recover).  Only after a probe succeeds does this process
+    initialize jax itself."""
+    plat = os.environ.get("GUBER_BENCH_PLATFORM", "")
+    probe_code = (
+        "import os, jax\n"
+        f"plat = {plat!r}\n"
+        "if plat: jax.config.update('jax_platforms', plat)\n"
+        "jax.block_until_ready(jax.numpy.zeros((8,)) + 1)\n"
+        "print('PROBE_OK', jax.devices()[0].platform)\n")
+    last = "probe never ran"
+    for i in range(attempts):
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", probe_code],
+                timeout=probe_timeout, capture_output=True)
+            if proc.returncode == 0 and b"PROBE_OK" in proc.stdout:
+                import jax
+
+                if plat:
+                    jax.config.update("jax_platforms", plat)
+                devs = jax.devices()
+                jax.block_until_ready(jax.numpy.zeros((8,)) + 1)
+                return devs
+            last = (proc.stderr or proc.stdout)[-300:].decode(
+                errors="replace")
+        except subprocess.TimeoutExpired:
+            last = f"probe hung >{probe_timeout:.0f}s (tunnel wedged?)"
         except Exception as e:  # noqa: BLE001 — deliberately broad: retry
-            last = e
-            delay = min(base_delay * (2 ** i), 30.0)
-            log(f"# backend attempt {i + 1}/{attempts} failed: "
-                f"{type(e).__name__}: {e}; retrying in {delay:.0f}s")
-            time.sleep(delay)
-    raise RuntimeError(f"TPU backend unavailable after {attempts} attempts: "
-                       f"{type(last).__name__}: {last}")
+            last = f"{type(e).__name__}: {e}"
+        log(f"# backend attempt {i + 1}/{attempts} failed after "
+            f"{time.time() - t0:.0f}s: {last}; retrying")
+        time.sleep(min(5.0 * (i + 1), 20.0))
+    raise RuntimeError(
+        f"TPU backend unavailable after {attempts} attempts: {last}")
 
 
-def bench_device(eng, kernel, jax, jnp, capacity, lanes, iters):
-    """Saturation: K pre-packed windows per dispatch, device round trip per
-    dispatch (serving demuxes responses between dispatches)."""
+def bench_device(kernel, jax, jnp, mesh, capacity, lanes, iters):
+    """Saturation: K pre-packed windows per dispatch, resident inputs,
+    un-fetched outputs (the kernel ceiling the host path chases)."""
+    import numpy as np
+    from gubernator_tpu.core.engine import RateLimitEngine
+
+    eng = RateLimitEngine(mesh=mesh, capacity_per_shard=capacity,
+                          batch_per_shard=lanes, global_capacity=1024,
+                          global_batch_per_shard=128, max_global_updates=128)
     K = 8
     N_STACKS = 4
-    ITERS = iters
-
     rng = np.random.default_rng(7)
 
     def pack_window():
@@ -116,26 +190,21 @@ def bench_device(eng, kernel, jax, jnp, capacity, lanes, iters):
     def dispatch(i, t):
         nows = jnp.arange(K, dtype=jnp.int64) + t
         return eng.step_windows(stacks[i % N_STACKS], gstack, gaccs,
-                                upd, ups, nows, n_decisions=K * lanes)
+                                upd, ups, nows, compact_safe=True,
+                                n_decisions=K * lanes)
 
     for i in range(3):  # warmup: compile + arena fill
         out = dispatch(i, now + i * K)
     jax.block_until_ready(out)
 
-    lat = []
     t0 = time.perf_counter()
-    for i in range(ITERS):
-        w0 = time.perf_counter()
+    for i in range(iters):
         out = dispatch(i, now + (3 + i) * K)
         jax.block_until_ready(out)
-        lat.append(time.perf_counter() - w0)
     total = time.perf_counter() - t0
-
-    per_sec = ITERS * K * lanes / total
-    lat_ms = np.array(lat) * 1000.0
-    log(f"# device tier: {ITERS} x {K} windows x {lanes} lanes; "
-        f"dispatch p50={np.percentile(lat_ms, 50):.3f}ms "
-        f"p99={np.percentile(lat_ms, 99):.3f}ms; capacity={capacity}")
+    per_sec = iters * K * lanes / total
+    log(f"# device tier: {iters} x {K} windows x {lanes} lanes "
+        f"-> {per_sec:,.0f} decisions/s; capacity={capacity}")
 
     # single-window dispatch latency (low-load serving path)
     sb = jax.device_put(kernel.WindowBatch(*[a[:1] for a in pack_window()]))
@@ -163,34 +232,114 @@ def bench_device(eng, kernel, jax, jnp, capacity, lanes, iters):
         np.percentile(slat_ms, 99))
 
 
-def bench_host(eng):
-    """engine.process(): the full host path per window — hashing, slot
-    allocation, packing (C++ router when available), dispatch, demux."""
-    from gubernator_tpu.api.types import RateLimitReq
+def _zipf_payloads(pb, n_payloads, items, keyspace, name):
+    import numpy as np
 
+    rng = np.random.default_rng(11)
+    payloads = []
+    for p in range(n_payloads):
+        keys = (rng.zipf(1.1, size=items) - 1) % keyspace
+        msg = pb.GetRateLimitsReq(requests=[
+            pb.RateLimitReq(name=name, unique_key=f"k{keys[i]}", hits=1,
+                            limit=1_000_000, duration=60_000,
+                            algorithm=int(keys[i]) % 2)
+            for i in range(items)])
+        payloads.append(msg.SerializeToString())
+    return payloads
+
+
+def bench_host_pipeline(mesh, capacity, lanes, seconds=5.0, concurrency=128):
+    """The pipelined host path: RPC bytes -> C parse -> stacked compact
+    dispatch -> C encode, fetches overlapped.  No gRPC socket."""
+    import asyncio
+
+    from gubernator_tpu.api import pb
+    from gubernator_tpu.config import BehaviorConfig
+    from gubernator_tpu.core.batcher import WindowBatcher
+    from gubernator_tpu.core.engine import RateLimitEngine
+
+    eng = RateLimitEngine(mesh=mesh, capacity_per_shard=capacity,
+                          batch_per_shard=lanes, global_capacity=1024,
+                          global_batch_per_shard=128, max_global_updates=128)
+    batcher = WindowBatcher(eng, BehaviorConfig())
+    assert batcher.pipeline is not None and batcher.pipeline.enabled
     N = 1000
-    reqs = [RateLimitReq(name="b", unique_key=f"k{i}", hits=1, limit=100,
+    payloads = _zipf_payloads(pb, 16, N, 100_000, "host")
+
+    import jax
+    eng.warmup()  # compiles every serving executable incl. all K buckets
+
+    prof_dir = os.environ.get("GUBER_PROFILE")
+    if prof_dir:
+        jax.profiler.start_trace(prof_dir)
+
+    async def run():
+        done = {"n": 0}
+        stop_at = time.perf_counter() + seconds
+
+        async def worker(wid):
+            i = 0
+            while time.perf_counter() < stop_at:
+                out = await batcher.submit_rpc(payloads[(wid + i) % 16])
+                assert out is not None
+                done["n"] += N
+                i += 1
+
+        # one warm round (slot tables, ramp)
+        await asyncio.gather(*(batcher.submit_rpc(p) for p in payloads[:4]))
+        t0 = time.perf_counter()
+        await asyncio.gather(*(worker(w) for w in range(concurrency)))
+        return done["n"] / (time.perf_counter() - t0)
+
+    per_sec = asyncio.run(run())
+    if prof_dir:
+        jax.profiler.stop_trace()
+    batcher.close()
+    log(f"# host tier (pipelined): {per_sec:,.0f} decisions/sec "
+        f"({concurrency} x {N}-item RPC streams)")
+    return per_sec
+
+
+def bench_host_sync(mesh, capacity, lanes, seconds=3.0):
+    """Legacy synchronous process() loop: one fetch round trip per window."""
+    from gubernator_tpu.api.types import RateLimitReq
+    from gubernator_tpu.core.engine import RateLimitEngine
+
+    eng = RateLimitEngine(mesh=mesh, capacity_per_shard=capacity,
+                          batch_per_shard=lanes, global_capacity=1024,
+                          global_batch_per_shard=128, max_global_updates=128)
+    N = 1000
+    reqs = [RateLimitReq(name="hs", unique_key=f"k{i}", hits=1, limit=100,
                          duration=60_000) for i in range(N)]
     now = 1_700_000_100_000
     eng.process(reqs, now=now)  # warm slot table + compile
     t0 = time.perf_counter()
     iters = 0
-    while time.perf_counter() - t0 < 3.0:
+    while time.perf_counter() - t0 < seconds:
         eng.process(reqs, now=now + 1 + iters)
         iters += 1
     per_sec = iters * N / (time.perf_counter() - t0)
-    log(f"# host tier: {per_sec:,.0f} decisions/sec "
+    log(f"# host tier (sync): {per_sec:,.0f} decisions/sec "
         f"({iters} x {N}-request process calls, "
         f"native={'yes' if eng.native is not None else 'no'})")
     return per_sec
 
 
-def bench_e2e(mesh):
-    """gRPC-in → response-out on a real loopback server: the number a client
-    of the serving daemon actually experiences at saturation."""
+def bench_e2e(mesh, capacity, lanes, seconds=5.0, concurrency=32):
+    """gRPC-in -> response-out on a real loopback server, plus the two
+    reference benchmark analogs (Ping RTT, ThunderingHeard).
+
+    Client and server share one process and event loop — this box has a
+    single CPU core, so a separate client process would just contend for
+    it (measured: 6x worse).  On the TPU the core mostly idles inside
+    fetch round trips, so the client's proto work interleaves cleanly.
+
+    The serving engine reuses the host tier's exact geometry so every
+    executable is already compiled (jit caches by mesh + shapes)."""
     import asyncio
 
     import grpc
+    import numpy as np
 
     from gubernator_tpu.api import pb
     from gubernator_tpu.api.grpc_api import V1Stub
@@ -199,78 +348,111 @@ def bench_e2e(mesh):
     from gubernator_tpu.server import GrpcServer
 
     N = 1000          # items per RPC (the reference's max batch)
-    CONCURRENCY = 8   # in-flight RPCs
-    SECONDS = 4.0
 
     async def run():
         inst = Instance(
             Config(
                 behaviors=BehaviorConfig(),
                 engine=EngineConfig(
-                    capacity_per_shard=1 << 20, batch_per_shard=1024,
+                    capacity_per_shard=capacity, batch_per_shard=lanes,
                     global_capacity=1024, global_batch_per_shard=128,
                     max_global_updates=128),
             ),
             mesh=mesh,
         )
+        inst.engine.warmup()
         srv = GrpcServer(inst, "127.0.0.1:0")
         await srv.start()
         chan = grpc.aio.insecure_channel(srv.address)
         stub = V1Stub(chan)
 
-        # pre-serialized payloads: rotate a few so responses vary but client
-        # serialization cost stays out of the measured loop
-        payloads = []
-        for p in range(4):
-            msg = pb.GetRateLimitsReq(requests=[
-                pb.RateLimitReq(name="e2e", unique_key=f"p{p}k{i}", hits=1,
-                                limit=1_000_000, duration=60_000,
-                                algorithm=i % 2)
-                for i in range(N)])
-            payloads.append(msg)
+        payloads = _zipf_payloads(pb, 8, N, 100_000, "e2e")
+        raw = chan.unary_unary(
+            "/pb.gubernator.V1/GetRateLimits",
+            request_serializer=lambda b: b,
+            response_deserializer=pb.GetRateLimitsResp.FromString)
 
         for p in payloads:  # warm: compile + slot tables
-            await stub.GetRateLimits(p)
+            await raw(p)
 
         done = {"n": 0}
-        stop_at = time.perf_counter() + SECONDS
+        stop_at = time.perf_counter() + seconds
 
         async def worker(wid):
             i = 0
             while time.perf_counter() < stop_at:
-                resp = await stub.GetRateLimits(payloads[(wid + i) % 4])
+                resp = await raw(payloads[(wid + i) % 8])
                 assert len(resp.responses) == N
                 done["n"] += N
                 i += 1
 
         t0 = time.perf_counter()
-        await asyncio.gather(*(worker(w) for w in range(CONCURRENCY)))
-        elapsed = time.perf_counter() - t0
+        await asyncio.gather(*(worker(w) for w in range(concurrency)))
+        e2e_ps = done["n"] / (time.perf_counter() - t0)
+        log(f"# e2e tier: {e2e_ps:,.0f} decisions/sec "
+            f"({N}-item RPCs x {concurrency} in flight)")
+
+        # --- HealthCheck RTT floor (benchmark_test.go:81) ---
+        ping = pb.HealthCheckReq()
+        rtts = []
+        for _ in range(100):
+            t = time.perf_counter()
+            await stub.HealthCheck(ping)
+            rtts.append(time.perf_counter() - t)
+        ping_p50 = float(np.percentile(np.array(rtts) * 1e3, 50))
+        log(f"# healthcheck rtt p50: {ping_p50:.3f}ms")
+
+        # --- ThunderingHeard: 100 concurrent single-item RPC loops
+        #     (benchmark_test.go:109).  Single-core box: this measures
+        #     python gRPC handling of 100 tiny concurrent streams as much
+        #     as the engine (the no-gRPC herd does ~13k rps). ---
+        single = [pb.GetRateLimitsReq(requests=[
+            pb.RateLimitReq(name="th", unique_key=f"t{i}", hits=1,
+                            limit=100_000, duration=60_000)]
+        ).SerializeToString() for i in range(100)]
+        lat = []
+        herd = {"n": 0}
+        stop_herd = time.perf_counter() + 2.0
+
+        async def herd_worker(wid):
+            while time.perf_counter() < stop_herd:
+                t = time.perf_counter()
+                await raw(single[wid])
+                lat.append(time.perf_counter() - t)
+                herd["n"] += 1
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(herd_worker(w) for w in range(100)))
+        herd_rps = herd["n"] / (time.perf_counter() - t0)
+        herd_p99 = float(np.percentile(np.array(lat) * 1e3, 99))
+        log(f"# thundering herd: {herd_rps:,.0f} rps, p99 {herd_p99:.2f}ms")
+
         await chan.close()
         await srv.stop(grace=0.2)
         inst.close()
-        return done["n"] / elapsed
+        return e2e_ps, ping_p50, herd_rps, herd_p99
 
-    per_sec = asyncio.run(run())
-    log(f"# e2e tier: {per_sec:,.0f} decisions/sec "
-        f"({N}-item RPCs x {CONCURRENCY} in flight)")
-    return per_sec
+    return asyncio.run(run())
 
 
-def main():
-    result = {
-        "metric": "rate_limit_decisions_per_sec_per_chip",
-        "value": 0.0,
-        "unit": "decisions/s",
-        "vs_baseline": 0.0,
-    }
+def child_main():
+    result = {}
     try:
         devs = acquire_backend()
         import jax
         import jax.numpy as jnp
 
+        # persistent compilation cache: ~10 serving executables x tens of
+        # seconds each over the tunnel; repeat runs should pay none of it
+        cache_dir = os.environ.get("GUBER_JAX_CACHE",
+                                   "/root/repo/.jax_cache")
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+        except Exception:
+            pass
+
         import gubernator_tpu  # noqa: F401
-        from gubernator_tpu.core.engine import RateLimitEngine
         from gubernator_tpu.ops import kernel
         from gubernator_tpu.parallel.mesh import make_mesh
 
@@ -285,34 +467,42 @@ def main():
         lanes = 4096 if on_cpu else 32768
         iters = 20 if on_cpu else 100
         mesh = make_mesh(devs[:1])
-        eng = RateLimitEngine(
-            mesh=mesh,
-            capacity_per_shard=capacity,
-            batch_per_shard=lanes,
-            global_capacity=1024,
-            global_batch_per_shard=128,
-            max_global_updates=128,
-        )
 
-        dev_ps, p50_ms, p99_ms = bench_device(eng, kernel, jax, jnp,
+        dev_ps, p50_ms, p99_ms = bench_device(kernel, jax, jnp, mesh,
                                               capacity, lanes, iters)
         result["device_decisions_per_sec"] = round(dev_ps, 1)
         result["window_p50_ms"] = round(p50_ms, 3)
         result["window_p99_ms"] = round(p99_ms, 3)
 
-        host_ps = bench_host(eng)
+        host_ps = bench_host_pipeline(mesh, capacity, lanes,
+                                      seconds=3.0 if on_cpu else 5.0,
+                                      concurrency=32 if on_cpu else 256)
         result["host_decisions_per_sec"] = round(host_ps, 1)
 
-        e2e_ps = bench_e2e(mesh)
+        sync_ps = bench_host_sync(mesh, capacity, lanes,
+                                  seconds=2.0 if on_cpu else 3.0)
+        result["host_sync_decisions_per_sec"] = round(sync_ps, 1)
+
+        e2e_ps, ping_p50, herd_rps, herd_p99 = bench_e2e(
+            mesh, capacity, lanes, seconds=3.0 if on_cpu else 5.0,
+            concurrency=8 if on_cpu else 32)
         result["e2e_decisions_per_sec"] = round(e2e_ps, 1)
+        result["healthcheck_rtt_ms_p50"] = round(ping_p50, 3)
+        result["thundering_herd_rps"] = round(herd_rps, 1)
+        result["thundering_herd_p99_ms"] = round(herd_p99, 2)
 
         result["value"] = round(e2e_ps, 1)
         result["vs_baseline"] = round(e2e_ps / BASELINE_REQS_PER_SEC, 2)
-    except Exception as e:  # noqa: BLE001 — the JSON line must still print
+    except Exception as e:  # noqa: BLE001 — the parent still prints JSON
+        import traceback
         traceback.print_exc()
         result["error"] = f"{type(e).__name__}: {e}"
-    print(json.dumps(result))
+    with open(os.environ[OUT_ENV], "w") as f:
+        f.write(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get(CHILD_ENV) == "1":
+        child_main()
+    else:
+        parent_main()
