@@ -262,7 +262,12 @@ def bench_host_pipeline(mesh, capacity, lanes, seconds=5.0, concurrency=128):
                           batch_per_shard=lanes, global_capacity=1024,
                           global_batch_per_shard=128, max_global_updates=128)
     batcher = WindowBatcher(eng, BehaviorConfig())
-    assert batcher.pipeline is not None and batcher.pipeline.enabled
+    if batcher.pipeline is None or not batcher.pipeline.enabled:
+        # no native router on this box: report 0 for this tier and let the
+        # sync/e2e tiers still produce their numbers
+        log("# host tier (pipelined): native router unavailable; skipped")
+        batcher.close()
+        return 0.0
     N = 1000
     payloads = _zipf_payloads(pb, 16, N, 100_000, "host")
 

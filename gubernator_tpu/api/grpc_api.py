@@ -45,10 +45,13 @@ def add_peers_servicer(server: grpc.aio.Server, servicer) -> None:
     """servicer: async GetPeerRateLimits(req, ctx), UpdatePeerGlobals(req,
     ctx), RegisterGlobals(req, ctx), ApplyGlobalRegistration(req, ctx)."""
     handlers = {
+        # bytes-level like V1.GetRateLimits: the servicer owns
+        # decode/encode so authoritative relays can run the native
+        # pipeline lane without materializing protobuf objects
         "GetPeerRateLimits": grpc.unary_unary_rpc_method_handler(
             servicer.GetPeerRateLimits,
-            request_deserializer=pb.GetPeerRateLimitsReq.FromString,
-            response_serializer=pb.GetPeerRateLimitsResp.SerializeToString,
+            request_deserializer=None,
+            response_serializer=None,
         ),
         "UpdatePeerGlobals": grpc.unary_unary_rpc_method_handler(
             servicer.UpdatePeerGlobals,

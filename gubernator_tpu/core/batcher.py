@@ -75,13 +75,14 @@ class WindowBatcher:
         return await loop.run_in_executor(
             self._executor, lambda: self.engine.process(reqs))
 
-    async def submit_rpc(self, data: bytes):
-        """Serve a whole serialized GetRateLimitsReq through the pipeline;
-        None => caller must use the full path (including in lockstep mode,
+    async def submit_rpc(self, data: bytes, peer_mode: bool = False):
+        """Serve a whole serialized GetRateLimitsReq (or, with peer_mode,
+        an authoritative GetPeerRateLimitsReq) through the pipeline; None
+        => caller must use the full path (including in lockstep mode,
         which has no pipeline)."""
         if self.pipeline is None:
             return None
-        return await self.pipeline.submit_rpc(data)
+        return await self.pipeline.submit_rpc(data, peer_mode=peer_mode)
 
     def start_lockstep(self) -> None:
         """Begin the lockstep tick loop (mesh mode; call inside the loop)."""

@@ -58,27 +58,142 @@ from gubernator_tpu.ops import kernel
 log = logging.getLogger("gubernator.pipeline")
 
 
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+    return bytes(out)
+
+
+def _frame(body: bytes) -> bytes:
+    """One repeated-field-1 entry (identical framing in GetRateLimitsResp
+    and GetPeerRateLimitsResp)."""
+    return b"\x0a" + _varint(len(body)) + body
+
+
+def _walk_frames(data: bytes) -> List[bytes]:
+    """Split a serialized response into its field-1 entry FRAMES (tag +
+    length + body), preserving order; skips unknown fields."""
+    frames = []
+    i, n = 0, len(data)
+    while i < n:
+        start = i
+        tag = 0
+        shift = 0
+        while True:
+            b = data[i]
+            i += 1
+            tag |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        wt = tag & 7
+        if wt == 2:
+            ln = 0
+            shift = 0
+            while True:
+                b = data[i]
+                i += 1
+                ln |= (b & 0x7F) << shift
+                if not (b & 0x80):
+                    break
+                shift += 7
+            end = i + ln
+            if tag >> 3 == 1:
+                frames.append(data[start:end])
+            i = end
+        elif wt == 0:
+            while data[i] & 0x80:
+                i += 1
+            i += 1
+        else:
+            raise ValueError("unsupported wire type in peer response")
+    return frames
+
+
+# metadata entry framing for the coordinator annotation the slow path puts
+# on forwarded responses (gubernator.go:151): RateLimitResp.metadata is
+# map<string,string> field 6; one entry is a {key=1, value=2} submessage.
+_META_OWNER_KEY = b"\x0a\x05owner"
+
+
+def _owner_metadata(host: str) -> bytes:
+    h = host.encode("utf-8")
+    entry = _META_OWNER_KEY + b"\x12" + _varint(len(h)) + h
+    return b"\x32" + _varint(len(entry)) + entry
+
+
+def _append_owner(frame: bytes, host: str) -> bytes:
+    """Annotate a framed RateLimitResp with metadata['owner'] by appending
+    the map entry to the body (protobuf fields concatenate)."""
+    body = _walk_body(frame) + _owner_metadata(host)
+    return _frame(body)
+
+
+def _walk_body(frame: bytes) -> bytes:
+    """Strip the tag+length framing off one field-1 entry."""
+    i = 1  # tag byte 0x0a
+    ln = 0
+    shift = 0
+    while True:
+        b = frame[i]
+        i += 1
+        ln |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            break
+        shift += 7
+    return frame[i:i + ln]
+
+
 class RpcJob:
     """A whole serialized GetRateLimitsReq served natively: C parse →
     stacked lanes → C proto encode.  Resolves to response BYTES, or None
-    when the RPC needs the full Python path."""
+    when the RPC needs the full Python path.
 
-    __slots__ = ("data", "fut", "n", "row", "lane", "limit")
+    Cluster mode: items the ring assigns to OTHER peers come back from the
+    parser as out_row < -1 markers with their serialized byte ranges; they
+    forward to their owners as spliced GetPeerRateLimitsReq BYTES (no
+    Python protobuf objects anywhere on the path) while the local items'
+    stacked fetch is in flight, and the response splices both back together
+    positionally (_assemble_mixed).  peer_mode marks the authoritative
+    peer-plane lane (GetPeerRateLimits): the ring is ignored and everything
+    is local, like the reference owner (gubernator.go:210-227)."""
 
-    def __init__(self, data: bytes, fut: asyncio.Future):
+    __slots__ = ("data", "fut", "n", "row", "lane", "limit", "off", "mlen",
+                 "remote_idx", "forward_task", "peer_mode")
+
+    def __init__(self, data: bytes, fut: asyncio.Future,
+                 peer_mode: bool = False):
         self.data = data
         self.fut = fut
+        self.peer_mode = peer_mode
         self.n = 0
         self.row = None
         self.lane = None
         self.limit = None
+        self.off = None
+        self.mlen = None
+        self.remote_idx = ()
+        self.forward_task = None
 
-    def finish(self, pipeline, wflat, clflat, now) -> bytes:
-        resp_buf = np.empty(self.n * 64 + 64, np.uint8)
-        m = pipeline.engine.native.fastpath_encode_w(
+    def finish(self, pipeline, wflat, clflat, now):
+        if not len(self.remote_idx):
+            resp_buf = np.empty(self.n * 64 + 64, np.uint8)
+            m = pipeline.engine.native.fastpath_encode_w(
+                wflat, self.limit, now, wflat.shape[-1], self.n,
+                self.row, self.lane, resp_buf, climit=clflat)
+            return bytes(resp_buf[:m])
+        # mixed RPC: encode the LOCAL items as framed per-item segments;
+        # forwarded slots splice in later (_assemble_mixed)
+        seg_buf = np.empty(self.n * 64 + 64, np.uint8)
+        item_off = np.empty(self.n, np.int64)
+        item_len = np.empty(self.n, np.int32)
+        pipeline.engine.native.fastpath_encode_parts(
             wflat, self.limit, now, wflat.shape[-1], self.n,
-            self.row, self.lane, resp_buf, climit=clflat)
-        return bytes(resp_buf[:m])
+            self.row, self.lane, seg_buf, item_off, item_len, climit=clflat)
+        return bytes(seg_buf), item_off, item_len
 
 
 class ListJob:
@@ -131,7 +246,7 @@ class ListJob:
 
 class _DrainResult:
     __slots__ = ("words", "limits", "mism", "staged", "fallback", "leftover",
-                 "now", "n_decisions", "error", "started")
+                 "now", "n_decisions", "error", "started", "ring_peers")
 
     def __init__(self):
         self.words = None
@@ -144,6 +259,7 @@ class _DrainResult:
         self.n_decisions = 0
         self.error = None
         self.started = 0.0
+        self.ring_peers = ()
 
 
 class DispatchPipeline:
@@ -168,15 +284,20 @@ class DispatchPipeline:
         self.depth = depth
         # injectable clock (tests pin it for differential comparisons)
         self.now_fn: Callable[[], int] = millisecond_now
-        # gate for the raw-RPC lane: requires a standalone instance (the C
-        # parser routes by crc % num_shards, valid only when this engine
-        # owns every key).  Instance.set_peers flips it; the drain re-reads
-        # it on the ENGINE thread so a membership change that races an
-        # in-flight RPC falls back instead of deciding non-owned keys.
+        # gate for the raw-RPC lane: requires a standalone instance or a
+        # cluster ring installed in the C parser (set_ring) so every item
+        # classifies local-vs-forward correctly.  Instance.set_peers flips
+        # it; the drain re-reads it on the ENGINE thread so a membership
+        # change that races an in-flight RPC falls back instead of deciding
+        # keys this node does not own.
         self.rpc_enabled = self.enabled
         # set by the batcher: async callable (reqs, accumulate) -> resps,
         # used when a list job needs the full path (legacy lane)
         self.legacy: Optional[Callable] = None
+        # PeerClients indexed like the C ring's peer indices; swapped
+        # ONLY on the engine thread (set_ring) so each drain snapshot is
+        # consistent with the markers the parser emitted
+        self._ring_peers: tuple = ()
         # truncation of the warmed bucket ladder (engine.warmup compiles
         # exactly PIPELINE_K_BUCKETS; never invent shapes it didn't warm)
         self._k_buckets = tuple(
@@ -190,18 +311,30 @@ class DispatchPipeline:
         self._jobs: List[object] = []     # FIFO of RpcJob/ListJob
         self._in_flight = 0
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # observability: RPCs fully served by this lane (tests assert the
+        # lane actually engaged rather than silently falling back)
+        self.rpc_served = 0
+
+    def install_ring(self, points, peer_of, peers, self_idx) -> None:
+        """Install the cluster ring (engine thread): the C parser's point
+        table and the aligned PeerClient list for forwards.  Empty points
+        clears back to standalone (everything local)."""
+        self.engine.native.set_ring(points, peer_of, self_idx)
+        self._ring_peers = tuple(peers)
 
     # ------------------------------------------------------------ submit API
 
-    async def submit_rpc(self, data: bytes) -> Optional[bytes]:
-        """Serve a whole serialized GetRateLimitsReq; None => the caller
-        must run the full Python path."""
+    async def submit_rpc(self, data: bytes,
+                         peer_mode: bool = False) -> Optional[bytes]:
+        """Serve a whole serialized GetRateLimitsReq (or, with peer_mode,
+        a GetPeerRateLimitsReq — same wire shape — authoritatively); None
+        => the caller must run the full Python path."""
         if not (self.enabled and self.rpc_enabled
                 and self.engine._compact_enabled) or self._closed:
             return None
         self._loop = asyncio.get_running_loop()
         fut = self._loop.create_future()
-        self._jobs.append(RpcJob(data, fut))
+        self._jobs.append(RpcJob(data, fut, peer_mode=peer_mode))
         self._pump()
         return await fut
 
@@ -286,11 +419,60 @@ class DispatchPipeline:
             self._in_flight -= 1
             self._pump()
             return
+        # start forwards for cluster-mode mixed RPCs NOW, so the peer round
+        # trips overlap the local stack's fetch
+        for job in res.staged:
+            if isinstance(job, RpcJob) and len(job.remote_idx):
+                job.forward_task = self._loop.create_task(
+                    self._forward_remote(job, res.ring_peers))
         cfut = self._loop.run_in_executor(self._fetch_executor,
                                           self._complete_sync, res)
         cfut.add_done_callback(lambda f: self._on_completed(f, res))
         # a second drain may dispatch while this one's fetch is in flight
         self._pump()
+
+    async def _forward_remote(self, job: RpcJob, ring_peers):
+        """Forward a mixed RPC's remote items to their ring owners as
+        spliced BYTES: per owner, the items' serialized RateLimitReq frames
+        concatenate into one GetPeerRateLimitsReq (same field-1 framing),
+        and the owner's framed responses come back positionally — the
+        reference's batch relay (peers.go:176-207) without materializing a
+        single Python protobuf object.  Returns {item_index: framed
+        RateLimitResp bytes} with per-item error semantics."""
+        from gubernator_tpu.api import pb
+
+        by_owner = {}
+        for i in job.remote_idx.tolist():
+            by_owner.setdefault(-2 - int(job.row[i]), []).append(i)
+
+        out = {}
+
+        async def one_owner(owner_idx, idxs):
+            peer = ring_peers[owner_idx]
+            body = b"".join(
+                b"\x0a" + _varint(int(job.mlen[i]))
+                + job.data[int(job.off[i]):int(job.off[i]) + int(job.mlen[i])]
+                for i in idxs)
+            try:
+                resp = await peer.get_peer_rate_limits_raw(body)
+                frames = _walk_frames(resp)
+                if len(frames) != len(idxs):
+                    raise RuntimeError(
+                        "number of rate limits in peer response does not "
+                        "match request")
+                for i, fr in zip(idxs, frames):
+                    out[i] = _append_owner(fr, peer.host)
+            except Exception as e:  # noqa: BLE001 — per-item error contract
+                err = pb.RateLimitResp(
+                    error=(f"while fetching rate limit from peer "
+                           f"{peer.host} - '{e}'")).SerializeToString()
+                fr = _frame(err)
+                for i in idxs:
+                    out[i] = fr
+
+        await asyncio.gather(*(one_owner(o, idxs)
+                               for o, idxs in by_owner.items()))
+        return out
 
     def _on_completed(self, fut, res: _DrainResult) -> None:
         self._in_flight -= 1
@@ -304,7 +486,11 @@ class DispatchPipeline:
             return
         for job, out in zip(res.staged, outs):
             if isinstance(job, RpcJob):
-                if not job.fut.done():
+                self.rpc_served += 1
+                if job.forward_task is not None:
+                    self._loop.create_task(
+                        self._assemble_mixed(job, out, res.now))
+                elif not job.fut.done():
                     job.fut.set_result(out)
             elif job.futs is not None:
                 for f, r in zip(job.futs, out):
@@ -319,6 +505,26 @@ class DispatchPipeline:
             self.metrics.window_duration.observe(
                 time.monotonic() - res.started)
         self._pump()
+
+    async def _assemble_mixed(self, job: RpcJob, local_parts, now) -> None:
+        """Splice a mixed RPC's locally-encoded framed segments with its
+        forwarded framed responses, positionally, into the final
+        GetRateLimitsResp bytes."""
+        try:
+            seg_buf, item_off, item_len = local_parts
+            fwd = await job.forward_task
+            parts = []
+            for i in range(job.n):
+                if item_len[i]:
+                    o = int(item_off[i])
+                    parts.append(seg_buf[o:o + int(item_len[i])])
+                else:
+                    parts.append(fwd[i])
+            if not job.fut.done():
+                job.fut.set_result(b"".join(parts))
+        except Exception as e:  # noqa: BLE001
+            if not job.fut.done():
+                job.fut.set_exception(e)
 
     def _route_fallback(self, job) -> None:
         if isinstance(job, RpcJob):
@@ -372,6 +578,7 @@ class DispatchPipeline:
         kcur = np.zeros(S, np.int32)
         native.drain_begin()
         stack_empty = True
+        res.ring_peers = self._ring_peers
         for idx, job in enumerate(jobs):
             if isinstance(job, RpcJob):
                 if not rpc_ok:
@@ -380,13 +587,18 @@ class DispatchPipeline:
                 job.row = np.empty(MAX_BATCH_SIZE, np.int32)
                 job.lane = np.empty(MAX_BATCH_SIZE, np.int32)
                 job.limit = np.empty(MAX_BATCH_SIZE, np.int64)
+                job.off = np.empty(MAX_BATCH_SIZE, np.int64)
+                job.mlen = np.empty(MAX_BATCH_SIZE, np.int32)
                 n = native.fastpath_parse_stack(
                     job.data, now, B, K, MAX_BATCH_SIZE, packed, kcur,
-                    fills, job.row, job.lane, job.limit)
+                    fills, job.row, job.lane, job.limit, job.off, job.mlen,
+                    use_ring=not job.peer_mode)
                 if n >= 0:
                     job.n = n
+                    job.remote_idx = np.flatnonzero(job.row[:n] < -1)
                     res.staged.append(job)
-                    stack_empty = False
+                    if len(job.remote_idx) < n:
+                        stack_empty = False
                 elif n == -6 and not stack_empty:
                     res.leftover = jobs[idx:]
                     break
@@ -413,23 +625,31 @@ class DispatchPipeline:
         if not res.staged:
             return res
         k_used = int(fills.any(axis=1).sum())
-        kb = next(b for b in self._k_buckets if b >= k_used)
-        try:
-            words, limits, mism = eng.pipeline_dispatch(
-                packed[:kb], np.full(kb, now, np.int64), n_windows=k_used)
-            native.commit()
-        except Exception as e:
-            native.abort()
-            res.error = e
-            return res
-        # start the device→host copies NOW so they overlap the next drain
-        try:
-            words.copy_to_host_async()
-            mism.copy_to_host_async()
-        except Exception:
-            pass  # fetch path will block instead
-        res.words, res.limits, res.mism = words, limits, mism
-        res.n_decisions = sum(j.n for j in res.staged)
+        if k_used:  # an all-forwarded drain has nothing to dispatch
+            kb = next(b for b in self._k_buckets if b >= k_used)
+            try:
+                words, limits, mism = eng.pipeline_dispatch(
+                    packed[:kb], np.full(kb, now, np.int64),
+                    n_windows=k_used)
+                native.commit()
+            except Exception as e:
+                native.abort()
+                res.error = e
+                return res
+            # start the device→host copies NOW, overlapping the next drain
+            try:
+                words.copy_to_host_async()
+                mism.copy_to_host_async()
+            except Exception:
+                pass  # fetch path will block instead
+            res.words, res.limits, res.mism = words, limits, mism
+        else:
+            native.commit()  # nothing staged: empty by construction
+        # forwarded items are the OWNER's decisions, not ours — counting
+        # them here would double-count cluster-wide (the owner's peer-lane
+        # drain counts them)
+        res.n_decisions = sum(
+            j.n - len(getattr(j, "remote_idx", ())) for j in res.staged)
         # counted here, ON the engine thread — the legacy path's
         # engine.process increments the same attribute from this thread,
         # so updating it from the event loop would race (lost updates)
@@ -440,13 +660,17 @@ class DispatchPipeline:
 
     def _complete_sync(self, res: _DrainResult):
         B = self.engine.batch_per_shard
-        words = np.ascontiguousarray(np.asarray(res.words))
-        mism = np.asarray(res.mism)
-        clflat = None
-        if mism.any():
-            clflat = np.ascontiguousarray(
-                np.asarray(res.limits)).reshape(-1, B)
-        wflat = words.reshape(-1, B)
+        if res.words is None:  # all-forwarded drain: nothing was dispatched
+            wflat = np.empty((0, B), np.int64)
+            clflat = None
+        else:
+            words = np.ascontiguousarray(np.asarray(res.words))
+            mism = np.asarray(res.mism)
+            clflat = None
+            if mism.any():
+                clflat = np.ascontiguousarray(
+                    np.asarray(res.limits)).reshape(-1, B)
+            wflat = words.reshape(-1, B)
         outs = [job.finish(self, wflat, clflat, res.now)
                 for job in res.staged]
         return res, outs

@@ -356,18 +356,20 @@ class Instance:
         new_hosts = {p.host for p in picker.peers()}
         departed = [self._picker.get_by_host(h) for h in old_hosts - new_hosts]
 
+        # gate the native RPC lane CLOSED across the swap: a drain queued
+        # between the picker swap and the ring install would otherwise
+        # classify against the stale (or empty) C ring and decide keys this
+        # node no longer owns; _sync_pipeline_ring re-opens it after the
+        # new ring is installed on the engine thread
+        if self.batcher.pipeline is not None:
+            self.batcher.pipeline.rpc_enabled = False
         self._picker = picker
         self.health = HealthCheckResp(
             status=UNHEALTHY if errs else HEALTHY,
             message="|".join(errs),
             peer_count=picker.size(),
         )
-        if self.batcher.pipeline is not None:
-            # the raw-RPC lane is only sound while standalone (the C parser
-            # routes by crc % num_shards, not the peer ring); flip the flag
-            # the drain re-reads on the engine thread
-            self.batcher.pipeline.rpc_enabled = (
-                self.batcher.pipeline.enabled and self.standalone)
+        await self._sync_pipeline_ring()
         if not self.mesh_mode:
             # mesh mode replicates GLOBAL state through the in-mesh psum;
             # the gRPC async-hits/broadcast loops stay off
@@ -376,6 +378,41 @@ class Instance:
         for client in departed:
             if client is not None:
                 await client.close()
+
+    async def _sync_pipeline_ring(self) -> None:
+        """Keep the native RPC lane's view of the cluster consistent with
+        the picker: standalone => empty ring (everything local); cluster =>
+        install the consistent-hash table so the C parser classifies each
+        item local-vs-forward (reference analog: the per-item
+        owner-vs-forward split, gubernator.go:114-152).  The ring install
+        runs on the engine thread, serialized with in-flight drains."""
+        pipe = self.batcher.pipeline
+        if pipe is None or not pipe.enabled:
+            return
+        import numpy as np
+        loop = asyncio.get_running_loop()
+        if self.mesh_mode:
+            pipe.rpc_enabled = False
+            return
+        if self._picker.size() == 0:
+            await loop.run_in_executor(
+                self.batcher._executor, pipe.install_ring,
+                np.empty(0, np.uint32), np.empty(0, np.int32), (), -1)
+            pipe.rpc_enabled = True
+            return
+        points, peers = self._picker.ring_table()
+        self_idx = next(
+            (i for i, p in enumerate(peers) if getattr(p, "is_owner", False)),
+            -1)
+        if self_idx < 0:
+            # cannot identify self on the ring: the lane cannot classify
+            pipe.rpc_enabled = False
+            return
+        await loop.run_in_executor(
+            self.batcher._executor, pipe.install_ring,
+            np.asarray(points, np.uint32),
+            np.arange(len(points), dtype=np.int32), tuple(peers), self_idx)
+        pipe.rpc_enabled = True
 
     def close(self) -> None:
         self.global_mgr.stop()

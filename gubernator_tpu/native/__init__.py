@@ -72,8 +72,18 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.fastpath_parse_stack.restype = ctypes.c_int64
         lib.fastpath_parse_stack.argtypes = [
             ctypes.c_void_p, u8p, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
-            i64p, i32p, i32p, i32p, i32p, i64p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, ctypes.c_int32,
+            i64p, i32p, i32p, i32p, i32p, i64p, i64p, i32p,
+        ]
+        lib.fastpath_encode_parts.restype = ctypes.c_int64
+        lib.fastpath_encode_parts.argtypes = [
+            i64p, i64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
+            i32p, i32p, i64p, u8p, ctypes.c_int64, i64p, i32p,
+        ]
+        lib.router_set_ring.restype = None
+        lib.router_set_ring.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32), i32p,
+            ctypes.c_int32, ctypes.c_int32,
         ]
         lib.router_pack_stack.restype = ctypes.c_int64
         lib.router_pack_stack.argtypes = [
@@ -192,19 +202,58 @@ class NativeRouter:
                              K: int, max_items: int, packed: np.ndarray,
                              kcur: np.ndarray, shard_fill: np.ndarray,
                              out_row: np.ndarray, out_lane: np.ndarray,
-                             out_limit: np.ndarray) -> int:
+                             out_limit: np.ndarray, out_off: np.ndarray,
+                             out_mlen: np.ndarray,
+                             use_ring: bool = True) -> int:
         """Serialized GetRateLimitsReq -> lanes staged across a K-window
-        compact stack.  Returns n >= 0 (requests staged) or a negative
-        fallback code; see host_router.cc."""
+        compact stack.  Returns n >= 0 (requests parsed; ring-remote items
+        are NOT staged and come back as out_row < -1 markers with their
+        message byte ranges in out_off/out_mlen) or a negative fallback
+        code; see host_router.cc.  use_ring=False treats every item as
+        local (the authoritative peer-plane lane)."""
         # zero-copy read-only view of the immutable bytes
         buf = ctypes.cast(ctypes.c_char_p(data),
                           ctypes.POINTER(ctypes.c_uint8))
         return self._lib.fastpath_parse_stack(
             self._handle, buf, len(data), now, lanes, K, max_items,
+            1 if use_ring else 0,
             _ptr(packed, ctypes.c_int64), _ptr(kcur, ctypes.c_int32),
             _ptr(shard_fill, ctypes.c_int32),
             _ptr(out_row, ctypes.c_int32), _ptr(out_lane, ctypes.c_int32),
-            _ptr(out_limit, ctypes.c_int64),
+            _ptr(out_limit, ctypes.c_int64), _ptr(out_off, ctypes.c_int64),
+            _ptr(out_mlen, ctypes.c_int32),
+        )
+
+    def fastpath_encode_parts(self, w0: np.ndarray, item_limit: np.ndarray,
+                              now: int, lanes: int, n: int,
+                              out_row: np.ndarray, out_lane: np.ndarray,
+                              resp_buf: np.ndarray, item_off: np.ndarray,
+                              item_len: np.ndarray,
+                              climit: Optional[np.ndarray] = None) -> int:
+        """Per-item FRAMED response segments for splicing with forwarded
+        peers' bytes (mixed-ownership RPCs); see host_router.cc."""
+        cl = _ptr(climit, ctypes.c_int64) if climit is not None else None
+        m = self._lib.fastpath_encode_parts(
+            _ptr(w0, ctypes.c_int64), _ptr(item_limit, ctypes.c_int64),
+            now, lanes, n,
+            _ptr(out_row, ctypes.c_int32), _ptr(out_lane, ctypes.c_int32),
+            cl, _ptr(resp_buf, ctypes.c_uint8), resp_buf.nbytes,
+            _ptr(item_off, ctypes.c_int64), _ptr(item_len, ctypes.c_int32),
+        )
+        if m < 0:
+            raise RuntimeError("fastpath_encode_parts: buffer too small")
+        return m
+
+    def set_ring(self, points: np.ndarray, peer_of: np.ndarray,
+                 self_idx: int) -> None:
+        """Install (or clear, empty points) the cluster consistent-hash
+        ring for per-item local-vs-forward classification.  Must run on the
+        engine thread (serialized with staging calls)."""
+        n = len(points)
+        self._lib.router_set_ring(
+            self._handle,
+            points.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            _ptr(peer_of, ctypes.c_int32), n, self_idx,
         )
 
     def pack_stack(self, key_bytes: np.ndarray, key_ends: np.ndarray,

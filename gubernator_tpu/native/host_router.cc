@@ -121,6 +121,13 @@ struct Router {
   int32_t exact;              // exact-key guard enabled
   uint8_t* scratch;           // assembled hash_key scratch (exact mode)
   int64_t scratch_cap;
+  // cluster mode: the consistent-hash ring (reference hash.go:28-96) so
+  // the RPC parser can classify items local-vs-forward per key.  Empty
+  // (ring_len == 0) means standalone: every key is local.
+  uint32_t* ring_points;      // sorted hash points
+  int32_t* ring_peer;         // peer index per point
+  int32_t ring_len;
+  int32_t ring_self;          // this node's peer index
 };
 
 uint32_t next_pow2(uint32_t v) {
@@ -364,7 +371,32 @@ Router* router_new_mesh(int32_t num_global_shards, int32_t shard_offset,
   r->exact = 0;
   r->scratch = nullptr;
   r->scratch_cap = 0;
+  r->ring_points = nullptr;
+  r->ring_peer = nullptr;
+  r->ring_len = 0;
+  r->ring_self = -1;
   return r;
+}
+
+// Install (or clear, n == 0) the cluster's consistent-hash ring so
+// fastpath_parse_stack can classify items per key.  points must be sorted
+// ascending; peer_of[i] is the peer index owning point i; self_idx is this
+// node's peer index.  Caller must serialize with staging calls (the engine
+// executor thread does).
+void router_set_ring(Router* r, const uint32_t* points,
+                     const int32_t* peer_of, int32_t n, int32_t self_idx) {
+  free(r->ring_points);
+  free(r->ring_peer);
+  r->ring_points = nullptr;
+  r->ring_peer = nullptr;
+  r->ring_len = n;
+  r->ring_self = self_idx;
+  if (n > 0) {
+    r->ring_points = (uint32_t*)malloc(sizeof(uint32_t) * n);
+    r->ring_peer = (int32_t*)malloc(sizeof(int32_t) * n);
+    memcpy(r->ring_points, points, sizeof(uint32_t) * n);
+    memcpy(r->ring_peer, peer_of, sizeof(int32_t) * n);
+  }
 }
 
 // Enable the exact-key collision guard.  Must be called before any key is
@@ -432,6 +464,8 @@ void router_free(Router* r) {
   free(r->shards);
   free(r->commit_list);
   free(r->scratch);
+  free(r->ring_points);
+  free(r->ring_peer);
   free(r);
 }
 
@@ -616,6 +650,9 @@ struct ParsedItem {
   int32_t shard;  // local shard index
   uint64_t fp;
   int64_t scratch_off;  // assembled hash_key offset (exact mode)
+  int32_t owner;        // ring peer index (-1 == local / no ring)
+  int64_t msg_off;      // serialized RateLimitReq body within the RPC bytes
+  int32_t msg_len;
 };
 
 // Parse one serialized RateLimitReq message body into *it (no validation).
@@ -714,6 +751,19 @@ uint8_t* scratch_reserve(Router* r, int64_t need) {
   return r->scratch;
 }
 
+// Successor point with wraparound (reference hash.go:80-96 / the Python
+// ring's bisect_left): owner of hash h.
+inline int32_t ring_owner(const Router* r, uint32_t h) {
+  int32_t lo = 0, hi = r->ring_len;
+  while (lo < hi) {
+    int32_t mid = (lo + hi) / 2;
+    if (r->ring_points[mid] < h) lo = mid + 1;
+    else hi = mid;
+  }
+  if (lo == r->ring_len) lo = 0;
+  return r->ring_peer[lo];
+}
+
 }  // namespace
 
 // Parse a serialized GetRateLimitsReq and stage it into a STACK of K
@@ -735,6 +785,13 @@ uint8_t* scratch_reserve(Router* r, int64_t need) {
 // (out_limit feeds the response encoder, which echoes the request limit —
 // see fastpath_encode_w).
 //
+// Cluster mode (router_set_ring installed): items whose ring owner is a
+// DIFFERENT peer are not staged; they come back marked
+// out_row[i] = -2 - owner with their serialized RateLimitReq body range in
+// out_off/out_mlen, so the host forwards just those items without
+// re-parsing the RPC (reference analog: the per-item owner-vs-forward
+// split, gubernator.go:114-152).
+//
 // Returns the request count n >= 0, or:
 //   -1  malformed protobuf
 //   -2  a request needs the full path (behavior/algorithm/validation/range)
@@ -742,12 +799,17 @@ uint8_t* scratch_reserve(Router* r, int64_t need) {
 //   -6  the RPC does not fit in this stack's remaining lanes (caller
 //       dispatches the stack and retries on a fresh one; -6 on a FRESH
 //       stack means the RPC can never fit and must take the full path)
+// use_ring == 0 treats every item as local even when a ring is installed:
+// the peer-plane lane (GetPeerRateLimits) is authoritative for whatever it
+// receives, like the reference owner (gubernator.go:210-227).
 int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
                              int64_t now, int32_t lanes, int32_t K,
-                             int64_t max_items, int64_t* packed,
+                             int64_t max_items, int32_t use_ring,
+                             int64_t* packed,
                              int32_t* kcur, int32_t* shard_fill,
                              int32_t* out_row, int32_t* out_lane,
-                             int64_t* out_limit) {
+                             int64_t* out_limit, int64_t* out_off,
+                             int32_t* out_mlen) {
   int32_t S = r->num_shards;
   if (S > MAX_STACK_SHARDS) return -2;
   if (max_items > MAX_STACK_ITEMS) max_items = MAX_STACK_ITEMS;
@@ -782,6 +844,8 @@ int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
       return -1;
     if (n >= max_items) return -3;
     ParsedItem* it = &items[n];
+    it->msg_off = p - buf;
+    it->msg_len = (int32_t)mlen;
     uint64_t behavior;
     if (!parse_item(p, p + mlen, it, &behavior)) return -1;
     p += mlen;
@@ -800,6 +864,17 @@ int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
     c = crc32_update(c, &sep, 1);
     c = crc32_update(c, it->key, it->key_len);
     uint32_t crc = c ^ 0xFFFFFFFFu;
+
+    it->owner = -1;  // local
+    if (use_ring && r->ring_len > 0) {
+      int32_t owner = ring_owner(r, crc);
+      if (owner != r->ring_self) {
+        it->owner = owner;  // forwarded: parsed but never staged
+        n++;
+        continue;
+      }
+    }
+
     uint64_t fp = fnv1a_update(1469598103934665603ull, it->name,
                                it->name_len);
     fp = fnv1a_update(fp, &sep, 1);
@@ -823,6 +898,14 @@ int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
   uint8_t* scratch = r->exact ? scratch_reserve(r, scratch_need) : nullptr;
   for (int64_t i = 0; i < n; i++) {
     ParsedItem* it = &items[i];
+    if (it->owner >= 0) {  // forwarded item: marker + message byte range
+      out_row[i] = -2 - it->owner;
+      out_lane[i] = -1;
+      out_limit[i] = it->limit;
+      out_off[i] = it->msg_off;
+      out_mlen[i] = it->msg_len;
+      continue;
+    }
     const uint8_t* kb = nullptr;
     int64_t kl = 0;
     if (r->exact) {
@@ -940,6 +1023,66 @@ int64_t fastpath_encode_w(const int64_t* w0, const int64_t* item_limit,
       *w++ = (4u << 3) | 0;
       w = write_varint(w, (uint64_t)reset);
     }
+  }
+  return w - out;
+}
+
+// Encode the fetched response-word plane as PER-ITEM FRAMED segments —
+// each local item becomes `0x0a + varint(len) + RateLimitResp body` at
+// out[item_off[i] .. +item_len[i]] (the framing of one repeated-field
+// entry, identical in GetRateLimitsResp and GetPeerRateLimitsResp).
+// Forwarded items (rows[i] < 0) get item_len[i] == 0; the host splices the
+// peer's framed response bytes there instead.  Returns total bytes
+// written, or -1 if out_cap is too small.
+int64_t fastpath_encode_parts(const int64_t* w0, const int64_t* item_limit,
+                              int64_t now, int32_t lanes, int64_t n,
+                              const int32_t* rows, const int32_t* lanes_arr,
+                              const int64_t* climit, uint8_t* out,
+                              int64_t out_cap, int64_t* item_off,
+                              int32_t* item_len) {
+  uint8_t* w = out;
+  uint8_t* wend = out + out_cap;
+  for (int64_t i = 0; i < n; i++) {
+    if (rows[i] < 0) {
+      item_off[i] = w - out;
+      item_len[i] = 0;
+      continue;
+    }
+    int64_t o = (int64_t)rows[i] * lanes + lanes_arr[i];
+    int64_t word = w0[o];
+    int64_t limit = climit ? climit[o] : item_limit[i];
+    int64_t remaining = word & 0x7FFFFFFFll;
+    int64_t status = (word >> 31) & 1;
+    int64_t enc = (word >> 32) & 0xFFFFFFFFll;
+    int64_t reset = enc == 0 ? 0 : now + enc - 1;
+
+    int body = 0;
+    if (status) body += 1 + varint_size((uint64_t)status);
+    if (limit) body += 1 + varint_size((uint64_t)limit);
+    if (remaining) body += 1 + varint_size((uint64_t)remaining);
+    if (reset) body += 1 + varint_size((uint64_t)reset);
+    if (w + 1 + varint_size((uint64_t)body) + body > wend) return -1;
+    uint8_t* seg = w;
+    *w++ = (1u << 3) | 2;
+    w = write_varint(w, (uint64_t)body);
+    if (status) {
+      *w++ = (1u << 3) | 0;
+      w = write_varint(w, (uint64_t)status);
+    }
+    if (limit) {
+      *w++ = (2u << 3) | 0;
+      w = write_varint(w, (uint64_t)limit);
+    }
+    if (remaining) {
+      *w++ = (3u << 3) | 0;
+      w = write_varint(w, (uint64_t)remaining);
+    }
+    if (reset) {
+      *w++ = (4u << 3) | 0;
+      w = write_varint(w, (uint64_t)reset);
+    }
+    item_off[i] = seg - out;
+    item_len[i] = (int32_t)(w - seg);
   }
   return w - out;
 }

@@ -32,6 +32,7 @@ class PeerClient:
         # insecure channel, like the reference (peers.go:132)
         self.channel = grpc.aio.insecure_channel(host)
         self.stub = PeersV1Stub(self.channel)
+        self._raw_batch = None  # bytes-level relay, built on first use
         self._pending: List[tuple] = []  # (req, future)
         self._interval: Optional[ArmedInterval] = None
         self._waiter: Optional[asyncio.Task] = None
@@ -66,6 +67,18 @@ class PeerClient:
             for g in globals_
         ])
         await self.stub.UpdatePeerGlobals(msg, timeout=self.conf.global_timeout)
+
+    async def get_peer_rate_limits_raw(self, data: bytes) -> bytes:
+        """Bytes-level batch relay: the caller splices serialized
+        RateLimitReq frames straight into the request and gets framed
+        responses back — the whole forward path without materializing
+        protobuf objects (used by the pipeline's mixed-RPC flow)."""
+        if self._raw_batch is None:
+            self._raw_batch = self.channel.unary_unary(
+                "/pb.gubernator.PeersV1/GetPeerRateLimits",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b)
+        return await self._raw_batch(data, timeout=self.conf.batch_timeout)
 
     async def register_globals(self, specs: List[tuple]) -> None:
         """Forward (key, limit, duration, algorithm) registrations to the

@@ -58,6 +58,11 @@ class ConsistentHashRing(Generic[T]):
             idx = 0  # wrap to the first point
         return self._by_point[self._points[idx]]
 
+    def ring_table(self):
+        """(sorted points, peer per point) — the native RPC parser's
+        classification table (host_router.cc router_set_ring)."""
+        return list(self._points), [self._by_point[p] for p in self._points]
+
 
 class MeshShardPicker(Generic[T]):
     """Mesh-mode PeerPicker: key -> global shard -> owning process -> host.

@@ -33,12 +33,14 @@ class _V1Servicer:
         inst = self.instance
         m = inst.metrics
         start = time.monotonic()
-        if inst.standalone and len(data) >= FASTPATH_MIN_BYTES:
+        if not inst.mesh_mode and len(data) >= FASTPATH_MIN_BYTES:
             # native RPC lane: C parse -> stacked compact dispatch -> C
-            # encode (core/pipeline.py); the drain re-checks standalone-ness
-            # on the engine thread, so a membership change that races this
-            # RPC falls back to the full path below instead of deciding
-            # keys this node no longer owns
+            # encode (core/pipeline.py).  In cluster mode the C parser
+            # classifies items per key against the installed ring and
+            # forwards non-owned items to their peers; the drain re-checks
+            # the gate on the engine thread, so a membership change that
+            # races this RPC falls back to the full path below instead of
+            # deciding keys this node does not own
             out = await inst.batcher.submit_rpc(data)
             if out is not None:
                 m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start,
@@ -75,9 +77,26 @@ class _PeersServicer:
     def __init__(self, instance: Instance):
         self.instance = instance
 
-    async def GetPeerRateLimits(self, request, context):
-        m = self.instance.metrics
+    async def GetPeerRateLimits(self, data: bytes, context):
+        inst = self.instance
+        m = inst.metrics
         start = time.monotonic()
+        if not inst.mesh_mode:
+            # authoritative relay through the native lane: identical wire
+            # shape to GetRateLimits, ring ignored (we are the owner for
+            # whatever arrives, gubernator.go:210-227)
+            out = await inst.batcher.submit_rpc(data, peer_mode=True)
+            if out is not None:
+                m.observe_rpc("/pb.gubernator.PeersV1/GetPeerRateLimits",
+                              start, ok=True)
+                return out
+        try:
+            request = pb.GetPeerRateLimitsReq.FromString(data)
+        except Exception:
+            m.observe_rpc("/pb.gubernator.PeersV1/GetPeerRateLimits", start,
+                          ok=False)
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                "malformed GetPeerRateLimitsReq")
         try:
             resps = await self.instance.get_peer_rate_limits(
                 [pb.req_from_pb(r) for r in request.requests])
@@ -86,7 +105,7 @@ class _PeersServicer:
             await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
         m.observe_rpc("/pb.gubernator.PeersV1/GetPeerRateLimits", start, ok=True)
         return pb.GetPeerRateLimitsResp(
-            rate_limits=[pb.resp_to_pb(r) for r in resps])
+            rate_limits=[pb.resp_to_pb(r) for r in resps]).SerializeToString()
 
     async def RegisterGlobals(self, request, context):
         start = time.monotonic()
