@@ -104,14 +104,14 @@ class WindowBatcher:
             delay = t0 + n * period - _time.monotonic()
             if delay > 0:
                 await asyncio.sleep(delay)
-            try:
-                if self.behaviors.lockstep_stack > 1:
-                    windows = [self._take_window()
-                               for _ in range(self.behaviors.lockstep_stack)]
-                else:
-                    windows = [self._take_window()]
-            except Exception:  # defensive: the tick loop must never die
-                windows = [[]]
+            # per-window try: a failure taking window k must not discard
+            # windows already taken (their futures would hang forever)
+            windows = []
+            for _ in range(max(self.behaviors.lockstep_stack, 1)):
+                try:
+                    windows.append(self._take_window())
+                except Exception:  # defensive: the tick loop must never die
+                    windows.append([])
             try:
                 await self._run_lockstep_window(windows)
             except Exception:

@@ -679,4 +679,14 @@ class DispatchPipeline:
         if not self.enabled:
             return
         self._closed = True
+        # fail still-queued jobs: _pump returns early once closed, so their
+        # futures would otherwise never resolve and callers hang
+        err = RuntimeError("pipeline closed")
+        jobs, self._jobs = self._jobs, []
+        singles, self._singles = self._singles, []
+        for job in jobs:
+            self._resolve_error(job, err)
+        for _, f in singles:
+            if not f.done():
+                f.set_exception(err)
         self._fetch_executor.shutdown(wait=False)

@@ -65,6 +65,8 @@ def _load() -> Optional[ctypes.CDLL]:
         for fn in ("router_size", "router_hits", "router_misses"):
             getattr(lib, fn).restype = ctypes.c_int64
             getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.router_heap_size.restype = ctypes.c_int64
+        lib.router_heap_size.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         for fn in ("router_commit", "router_drain_begin", "router_abort",
                    "router_set_exact"):
             getattr(lib, fn).restype = None
@@ -294,6 +296,11 @@ class NativeRouter:
         if m < 0:
             raise RuntimeError("fastpath_encode_w: response buffer too small")
         return m
+
+    def heap_size(self, shard: int = 0) -> int:
+        """Expiry-heap nodes (live + draining) for one shard — lets tests
+        assert the bounded-heap guarantee at churn scale."""
+        return self._lib.router_heap_size(self._handle, shard)
 
     @property
     def size(self) -> int:

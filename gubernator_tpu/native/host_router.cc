@@ -99,10 +99,18 @@ struct Shard {
   uint32_t* seq;  // pack sequence that last reported is_init for the entry
   // lazy expiry min-heap: lets a full shard reclaim an EXPIRED slot before
   // evicting a live LRU victim.  Nodes go stale when an entry is re-touched
-  // (its expiry moved) or evicted; staleness is detected on pop by
-  // comparing against the entry's live expire + residency.
+  // (its expiry moved) or evicted; staleness is detected on pop against the
+  // entry's live expire + residency.  To BOUND the heap at 100M-key scale
+  // without a stop-the-world rebuild (an O(capacity) pause lands mid-window
+  // at that size), overflow swaps the heap aside and drains it back a few
+  // nodes per touch (heap_old), and refresh pushes are suppressed when the
+  // expiry moved by less than duration/4 (reclaim correctness survives
+  // because a popped hint reclaims on the entry's CURRENT expiry, not the
+  // hint's).
   HeapNode* heap;
   int64_t heap_len, heap_cap;
+  HeapNode* heap_old;  // draining after an overflow swap (nullptr if none)
+  int64_t heap_old_len;
   // exact-key guard (opt-in, router_set_exact): stores each entry's full
   // key so a 64-bit fingerprint collision probes onward instead of silently
   // merging two keys' counters.  nullptr when disabled.
@@ -156,6 +164,8 @@ void shard_init(Shard* s, int32_t capacity) {
   s->seq = (uint32_t*)calloc(capacity, sizeof(uint32_t));
   s->heap = nullptr;
   s->heap_len = s->heap_cap = 0;
+  s->heap_old = nullptr;
+  s->heap_old_len = 0;
   s->keys = nullptr;
   s->klen = nullptr;
 }
@@ -166,39 +176,34 @@ inline bool is_resident(Shard* s, int32_t e) {
   return s->cells[s->cell_of[e]] == e;
 }
 
-void heap_sift_down(Shard* s, int64_t i) {
-  HeapNode v = s->heap[i];
-  for (;;) {
-    int64_t l = 2 * i + 1, r = l + 1, m = i;
-    int64_t best = v.expire;
-    if (l < s->heap_len && s->heap[l].expire < best) {
-      m = l;
-      best = s->heap[l].expire;
+// pop the min node off an arbitrary heap array (sift-down the last node)
+inline HeapNode heap_pop_min(HeapNode* heap, int64_t* len) {
+  HeapNode top = heap[0];
+  heap[0] = heap[--*len];
+  if (*len) {
+    int64_t i = 0;
+    HeapNode v = heap[0];
+    for (;;) {
+      int64_t l = 2 * i + 1, r = l + 1, m = i;
+      int64_t best = v.expire;
+      if (l < *len && heap[l].expire < best) {
+        m = l;
+        best = heap[l].expire;
+      }
+      if (r < *len && heap[r].expire < best) m = r;
+      if (m == i) break;
+      heap[i] = heap[m];
+      i = m;
     }
-    if (r < s->heap_len && s->heap[r].expire < best) m = r;
-    if (m == i) break;
-    s->heap[i] = s->heap[m];
-    i = m;
+    heap[i] = v;
   }
-  s->heap[i] = v;
+  return top;
 }
 
-void heap_push(Shard* s, int64_t expire, int32_t e) {
+void heap_insert(Shard* s, int64_t expire, int32_t e) {
   if (s->heap_len == s->heap_cap) {
-    if (s->heap_len > 4 * (int64_t)s->capacity) {
-      // mostly stale: rebuild from the resident entries (walk the LRU list)
-      s->heap_len = 0;
-      for (int32_t i = s->lru_head; i != NIL; i = s->next[i]) {
-        s->heap[s->heap_len].expire = s->expire[i];
-        s->heap[s->heap_len].e = i;
-        s->heap_len++;
-      }
-      for (int64_t i = s->heap_len / 2 - 1; i >= 0; i--) heap_sift_down(s, i);
-    }
-    if (s->heap_len == s->heap_cap) {
-      s->heap_cap = s->heap_cap ? s->heap_cap * 2 : 1024;
-      s->heap = (HeapNode*)realloc(s->heap, sizeof(HeapNode) * s->heap_cap);
-    }
+    s->heap_cap = s->heap_cap ? s->heap_cap * 2 : 1024;
+    s->heap = (HeapNode*)realloc(s->heap, sizeof(HeapNode) * s->heap_cap);
   }
   int64_t i = s->heap_len++;
   while (i > 0) {
@@ -209,6 +214,35 @@ void heap_push(Shard* s, int64_t expire, int32_t e) {
   }
   s->heap[i].expire = expire;
   s->heap[i].e = e;
+}
+
+// is node n still worth keeping as a reclaim hint?
+inline bool hint_live(Shard* s, const HeapNode& n) {
+  return s->cells[s->cell_of[n.e]] == n.e && s->expire[n.e] >= n.expire;
+}
+
+void heap_push(Shard* s, int64_t expire, int32_t e) {
+  // Overflow: swap the (mostly stale) heap aside and drain it back
+  // incrementally — a stop-the-world rebuild is an O(capacity) pause,
+  // which at the 100M-key target lands mid-serving-window.
+  if (s->heap_old == nullptr && s->heap_len > 4 * (int64_t)s->capacity) {
+    s->heap_old = s->heap;
+    s->heap_old_len = s->heap_len;
+    s->heap = nullptr;
+    s->heap_len = s->heap_cap = 0;
+  }
+  if (s->heap_old != nullptr) {
+    // amortized drain: far faster than the ~1 push/touch growth rate
+    for (int drained = 0; drained < 8 && s->heap_old_len > 0; drained++) {
+      HeapNode n = heap_pop_min(s->heap_old, &s->heap_old_len);
+      if (hint_live(s, n)) heap_insert(s, n.expire, n.e);
+    }
+    if (s->heap_old_len == 0) {
+      free(s->heap_old);
+      s->heap_old = nullptr;
+    }
+  }
+  heap_insert(s, expire, e);
 }
 
 
@@ -257,21 +291,50 @@ void table_delete_cell(Shard* s, uint32_t cell) {
   s->cells[hole] = NIL;
 }
 
-// pop expired entries until one is live-and-truly-expired; returns its
-// entry index (removed from table+LRU, ready for reuse) or NIL
+// Pop expired hints until one names a live-and-truly-expired entry;
+// returns its entry index (removed from table+LRU, ready for reuse) or
+// NIL.  Reclaim checks the entry's CURRENT expiry (not the hint's), so
+// hints left behind by the push-suppression rule still reclaim correctly;
+// a hint whose entry refreshed past `now` is RE-PUSHED at the entry's
+// current expiry (conserves hint coverage for hot-then-idle keys).  Work
+// per attempt is capped so an allocation never stalls on a stale-hint
+// burst (it falls back to LRU eviction instead).
 int32_t try_reclaim_expired(Shard* s, int64_t now) {
-  while (s->heap_len > 0 && s->heap[0].expire < now) {
-    HeapNode n = s->heap[0];
-    s->heap[0] = s->heap[--s->heap_len];
-    if (s->heap_len) heap_sift_down(s, 0);
-    int32_t e = n.e;
-    if (is_resident(s, e) && s->expire[e] == n.expire) {
-      lru_unlink(s, e);
-      table_delete_cell(s, s->cell_of[e]);
-      return e;
+  HeapNode repush[32];
+  int nr = 0;
+  int32_t out = NIL;
+  for (int iter = 0; iter < 32; iter++) {
+    HeapNode* heap;
+    int64_t* len;
+    if (s->heap_len > 0 && s->heap[0].expire < now) {
+      heap = s->heap;
+      len = &s->heap_len;
+    } else if (s->heap_old != nullptr && s->heap_old_len > 0 &&
+               s->heap_old[0].expire < now) {
+      heap = s->heap_old;
+      len = &s->heap_old_len;
+    } else {
+      break;
+    }
+    HeapNode n = heap_pop_min(heap, len);
+    if (!is_resident(s, n.e)) continue;  // dead hint
+    if (s->expire[n.e] < now) {
+      lru_unlink(s, n.e);
+      table_delete_cell(s, s->cell_of[n.e]);
+      out = n.e;
+      break;
+    }
+    if (nr < 32) {  // refreshed entry: restore an exact hint
+      repush[nr].expire = s->expire[n.e];
+      repush[nr++].e = n.e;
     }
   }
-  return NIL;
+  for (int i = 0; i < nr; i++) heap_insert(s, repush[i].expire, repush[i].e);
+  if (s->heap_old != nullptr && s->heap_old_len == 0) {
+    free(s->heap_old);
+    s->heap_old = nullptr;
+  }
+  return out;
 }
 
 // returns slot; *is_init set when the device must (re)initialize it.
@@ -295,9 +358,16 @@ int32_t shard_lookup(Shard* s, uint64_t fp, int64_t now, int64_t duration,
           memcmp(s->keys[e], key, key_len) == 0))) {
       if (s->expire[e] < now) s->misses++;  // expired touch counts as a miss
       else s->hits++;
-      if (s->expire[e] != now + duration) {
-        s->expire[e] = now + duration;
-        heap_push(s, now + duration, e);
+      int64_t ne = now + duration;
+      if (s->expire[e] != ne) {
+        // hint-churn suppression: re-push only when the expiry moved by
+        // more than duration/4 (or backwards).  Pop-time reclaim checks
+        // the entry's CURRENT expiry and re-pushes refreshed hints, so
+        // sparser hints stay correct — this is what keeps the heap bounded
+        // at the 100M-key scale instead of growing one node per touch.
+        bool push = ne - s->expire[e] > duration / 4 || ne < s->expire[e];
+        s->expire[e] = ne;
+        if (push) heap_push(s, ne, e);
       }
       lru_unlink(s, e);
       lru_push_front(s, e);
@@ -454,7 +524,7 @@ void router_free(Router* r) {
     Shard* s = &r->shards[i];
     free(s->cells); free(s->fp); free(s->expire); free(s->cell_of);
     free(s->prev); free(s->next); free(s->free_list);
-    free(s->pending); free(s->seq); free(s->heap);
+    free(s->pending); free(s->seq); free(s->heap); free(s->heap_old);
     if (s->keys != nullptr) {
       for (int32_t e = 0; e < s->capacity; e++) free(s->keys[e]);
       free(s->keys);
@@ -1085,6 +1155,13 @@ int64_t fastpath_encode_parts(const int64_t* w0, const int64_t* item_limit,
     item_len[i] = (int32_t)(w - seg);
   }
   return w - out;
+}
+
+// total expiry-heap nodes (live + draining) for one shard — test/debug
+// observability for the bounded-heap guarantees above
+int64_t router_heap_size(Router* r, int32_t shard) {
+  Shard* s = &r->shards[shard];
+  return s->heap_len + s->heap_old_len;
 }
 
 int64_t router_size(Router* r) {

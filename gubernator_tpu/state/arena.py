@@ -88,9 +88,16 @@ class SlotTable:
                 self.misses += 1
             else:
                 self.hits += 1
-            if ent[1] != now + duration:
-                ent[1] = now + duration
-                heapq.heappush(self._expiry_heap, (ent[1], key))
+            ne = now + duration
+            if ent[1] != ne:
+                # hint-churn suppression (mirrors native/host_router.cc):
+                # re-push only when the expiry moved by more than duration/4
+                # or backwards; _reclaim checks the entry's CURRENT expiry,
+                # so sparser hints stay correct while the heap stays bounded
+                push = ne - ent[1] > duration // 4 or ne < ent[1]
+                ent[1] = ne
+                if push:
+                    heapq.heappush(self._expiry_heap, (ne, key))
             self._entries.move_to_end(key)
             if ent[2] and ent[3] != self._seq:
                 # allocated by an earlier window that never dispatched
@@ -113,14 +120,32 @@ class SlotTable:
     def _reclaim(self, now: int) -> int:
         """Free a slot from a full table: prefer an EXPIRED entry (its
         device state reads as a miss anyway, kernel lazy-TTL), falling back
-        to strict LRU eviction (lru.go:92-94,131-136)."""
+        to strict LRU eviction (lru.go:92-94,131-136).
+
+        Mirrors native/host_router.cc try_reclaim_expired: reclaim is
+        decided by the entry's CURRENT expiry (hints may be sparse under
+        push suppression), a hint whose entry refreshed past `now` is
+        re-pushed at the current expiry, and work per attempt is capped so
+        an allocation never stalls on a stale-hint burst."""
         heap = self._expiry_heap
-        while heap and heap[0][0] < now:
+        repush = []
+        out = None
+        for _ in range(32):
+            if not heap or heap[0][0] >= now:
+                break
             exp, key = heapq.heappop(heap)
             ent = self._entries.get(key)
-            if ent is not None and ent[1] == exp:  # not stale: truly expired
+            if ent is None:
+                continue  # dead hint
+            if ent[1] < now:  # truly expired (current expiry, not hint's)
                 del self._entries[key]
-                return ent[0]
+                out = ent[0]
+                break
+            repush.append((ent[1], key))
+        for node in repush:
+            heapq.heappush(heap, node)
+        if out is not None:
+            return out
         if len(heap) > 4 * self.capacity:  # compact stale heap nodes
             self._expiry_heap = [(e[1], k) for k, e in self._entries.items()]
             heapq.heapify(self._expiry_heap)
