@@ -1316,10 +1316,28 @@ class RateLimitEngine:
 
 
 def _use_pallas() -> bool:
-    """Opt-in Pallas lowering for the GLOBAL apply pass (GUBER_PALLAS=1).
-    Read at trace time — i.e. once per mesh, when _compiled_step builds."""
+    """Opt-in Pallas lowering (GUBER_PALLAS=1) for the window kernel and
+    the GLOBAL apply pass (ops/pallas_kernel.py).  Read at trace time —
+    i.e. once per mesh, when each executable family builds."""
     import os
     return os.environ.get("GUBER_PALLAS") == "1"
+
+
+def _window_step_fn(mesh: Mesh):
+    """kernel.window_step, or its Pallas lowering under GUBER_PALLAS=1
+    (interpret mode when the MESH's devices are CPU — Mosaic is TPU-only,
+    and the process default backend may differ from the mesh platform)."""
+    if _use_pallas():
+        from functools import partial
+
+        from gubernator_tpu.ops.pallas_kernel import window_step_pallas
+        return partial(window_step_pallas,
+                       interpret=_mesh_on_cpu(mesh))
+    return kernel.window_step
+
+
+def _mesh_on_cpu(mesh: Mesh) -> bool:
+    return mesh.devices.flat[0].platform == "cpu"
 
 
 def _apply_control(gstate: BucketState, gcfg: GlobalConfig, upd, ups):
@@ -1360,7 +1378,7 @@ def _apply_control(gstate: BucketState, gcfg: GlobalConfig, upd, ups):
 
 
 def _global_window(gstate: BucketState, gcfg: GlobalConfig, gb: WindowBatch,
-                   gacc_row, now):
+                   gacc_row, now, mesh: Mesh):
     """One window of GLOBAL traffic: replica reads + the reconciliation psum.
 
     The whole GLOBAL dance — the reference's async hit send plus owner
@@ -1374,8 +1392,7 @@ def _global_window(gstate: BucketState, gcfg: GlobalConfig, gb: WindowBatch,
     if _use_pallas():
         from gubernator_tpu.ops.pallas_kernel import global_apply_pallas
         new_g = global_apply_pallas(
-            gstate, gcfg, summed, now,
-            interpret=jax.default_backend() == "cpu")
+            gstate, gcfg, summed, now, interpret=_mesh_on_cpu(mesh))
     else:
         new_g = kernel.global_apply(gstate, gcfg, summed, now)
     return new_g, gout
@@ -1388,11 +1405,11 @@ def _compiled_step(mesh: Mesh):
             # gstate/gcfg [G] (replicated); upd/ups [K*] (replicated).
             st = BucketState(*jax.tree.map(lambda a: a[0], state))
             bt = WindowBatch(*jax.tree.map(lambda a: a[0], batch))
-            new_st, out = kernel.window_step(st, bt, now)
+            new_st, out = _window_step_fn(mesh)(st, bt, now)
 
             gstate, gcfg = _apply_control(gstate, gcfg, upd, ups)
             gb = WindowBatch(*jax.tree.map(lambda a: a[0], gbatch))
-            new_g, gout = _global_window(gstate, gcfg, gb, gacc[0], now)
+            new_g, gout = _global_window(gstate, gcfg, gb, gacc[0], now, mesh)
 
             expand = lambda a: a[None]
             return (
@@ -1407,6 +1424,10 @@ def _compiled_step(mesh: Mesh):
     sharded = jax.shard_map(
         shard_fn,
         mesh=mesh,
+        # the Pallas window kernel cannot carry vma tags through its
+        # interpret-mode while_loop (jnp.take drops them); vma checking is
+        # an XLA-path-only invariant here
+        check_vma=not _use_pallas(),
         in_specs=(
             state_sharded,
             state_repl,
@@ -1442,11 +1463,11 @@ def _compiled_step_compact(mesh: Mesh):
     def shard_fn(state, gstate, gcfg, packed, gbatch, gacc, upd, ups, now):
         st = BucketState(*jax.tree.map(lambda a: a[0], state))
         bt = kernel.decode_batch(packed[0])
-        new_st, out = kernel.window_step(st, bt, now)
+        new_st, out = _window_step_fn(mesh)(st, bt, now)
 
         gstate, gcfg = _apply_control(gstate, gcfg, upd, ups)
         gb = WindowBatch(*jax.tree.map(lambda a: a[0], gbatch))
-        new_g, gout = _global_window(gstate, gcfg, gb, gacc[0], now)
+        new_g, gout = _global_window(gstate, gcfg, gb, gacc[0], now, mesh)
 
         expand = lambda a: a[None]
         gfused = jnp.stack(
@@ -1465,6 +1486,10 @@ def _compiled_step_compact(mesh: Mesh):
     sharded = jax.shard_map(
         shard_fn,
         mesh=mesh,
+        # the Pallas window kernel cannot carry vma tags through its
+        # interpret-mode while_loop (jnp.take drops them); vma checking is
+        # an XLA-path-only invariant here
+        check_vma=not _use_pallas(),
         in_specs=(
             state_sharded,
             state_repl,
@@ -1546,7 +1571,7 @@ def _compiled_pipeline_step(mesh: Mesh):
         def body(st, xs):
             pk, now = xs
             bt = kernel.decode_batch(pk[0])
-            st, out = kernel.window_step(st, bt, now)
+            st, out = _window_step_fn(mesh)(st, bt, now)
             word = kernel.encode_output_word(out, now)
             mism = jnp.any((out.limit != bt.limit) & (bt.slot >= 0))
             return st, (word, out.limit, mism)
@@ -1565,6 +1590,10 @@ def _compiled_pipeline_step(mesh: Mesh):
     sharded = jax.shard_map(
         shard_fn,
         mesh=mesh,
+        # the Pallas window kernel cannot carry vma tags through its
+        # interpret-mode while_loop (jnp.take drops them); vma checking is
+        # an XLA-path-only invariant here
+        check_vma=not _use_pallas(),
         in_specs=(state_sharded, stackedP, P()),
         out_specs=(state_sharded, stackedP, stackedP, stackedP),
     )
@@ -1598,9 +1627,9 @@ def _compiled_multi_step(mesh: Mesh):
             st, gst = carry
             b, gb, gacc, now = xs
             bt = WindowBatch(*jax.tree.map(lambda a: a[0], b))
-            st, out = kernel.window_step(st, bt, now)
+            st, out = _window_step_fn(mesh)(st, bt, now)
             gbt = WindowBatch(*jax.tree.map(lambda a: a[0], gb))
-            gst, gout = _global_window(gst, gcfg, gbt, gacc[0], now)
+            gst, gout = _global_window(gst, gcfg, gbt, gacc[0], now, mesh)
             return (st, gst), kernel.pack_outputs(out, gout)
 
         (st, gst), fused = lax.scan(
@@ -1621,6 +1650,10 @@ def _compiled_multi_step(mesh: Mesh):
     sharded = jax.shard_map(
         shard_fn,
         mesh=mesh,
+        # the Pallas window kernel cannot carry vma tags through its
+        # interpret-mode while_loop (jnp.take drops them); vma checking is
+        # an XLA-path-only invariant here
+        check_vma=not _use_pallas(),
         in_specs=(
             state_sharded,
             state_repl,

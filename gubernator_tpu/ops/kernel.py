@@ -260,99 +260,31 @@ def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh):
     return _Reg(*new_reg), WindowOutput(*out)
 
 
-def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketState, WindowOutput]:
-    """Apply one window of requests to the arena; returns (new_state, responses).
+def uniform_closed_form(st: _Reg, fresh0, h0, l0, d0, a0, pos, seg_len, now):
+    """Closed form of a UNIFORM segment (every lane same hits>0/config):
+    the greedy use-it-or-lose-it sequence decrements for the first
+    k* = min(len, r_start // h) lanes and rejects the rest without
+    mutating — matching algorithms.go:51-65/:136-148 item by item.
 
-    Equivalent to the owning node draining one batched GetPeerRateLimits RPC
-    item-by-item under the cache mutex (gubernator.go:210-227,236-251), but as
-    one device computation.  Responses are positionally aligned with the batch
-    (the reference demuxes by index, peers.go:204-207).
-    """
-    B = batch.slot.shape[0]
-    C = state.limit.shape[0]
-    now = jnp.asarray(now, dtype=I64)
-
-    valid = batch.slot >= 0
-    # Sort by slot (stable → arrival order preserved within a slot); pads last.
-    sort_key = jnp.where(valid, batch.slot, jnp.int32(2**31 - 1))
-    order = jnp.argsort(sort_key)
-    s_slot = sort_key[order]
-    s_valid = valid[order]
-    s_hits = batch.hits[order]
-    s_limit = batch.limit[order]
-    s_duration = batch.duration[order]
-    s_algo = batch.algo[order]
-    s_init = batch.is_init[order]
-
-    idx = jnp.arange(B, dtype=I32)
-    seg_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), s_slot[1:] != s_slot[:-1]])
-    seg_start_idx = lax.cummax(jnp.where(seg_start, idx, jnp.int32(0)))
-    pos = idx - seg_start_idx
-    # seg_len[i] = length of i's segment: next segment start minus own start
-    shifted = jnp.concatenate([
-        jnp.where(seg_start[1:], idx[1:], jnp.int32(B)),
-        jnp.full((1,), B, I32),
-    ])
-    next_start = jnp.flip(lax.cummin(jnp.flip(shifted)))
-    seg_len = next_start - seg_start_idx
-
-    # Registers: the live state of each segment's bucket, stored at the
-    # segment-start position.  Initialized from the arena.
-    g = jnp.clip(s_slot, 0, C - 1)
-    cur = _Reg(
-        limit=state.limit[g],
-        duration=state.duration[g],
-        remaining=state.remaining[g],
-        tstamp=state.tstamp[g],
-        expire=state.expire[g],
-        algo=state.algo[g],
-    )
-    # Miss conditions known before replay: fresh host allocation or lazy TTL
-    # expiry (lru.go:110: expireAt < now).  Algorithm switches are detected
-    # per-round against the live register.
-    cur_fresh = s_init | (cur.expire < now)
-
-    # ---- closed-form fast path for UNIFORM segments --------------------
-    # A hot key's duplicates are usually identical requests (same hits>0 and
-    # config).  The greedy use-it-or-lose-it sequence then has a closed form:
-    # the first k* = min(len, r_start // h) lanes decrement, the rest reject
-    # without mutating — matching algorithms.go:51-65/:136-148 item by item.
-    # Only *irregular* segments (mixed hits/config, zero-hit reads,
-    # mid-segment slot recycling) fall back to the replay rounds below, so a
-    # Zipf-skewed window no longer pays one round per duplicate.
-    h0 = s_hits[seg_start_idx]
-    l0 = s_limit[seg_start_idx]
-    d0 = s_duration[seg_start_idx]
-    a0 = s_algo[seg_start_idx]
-    lane_ok = (
-        (s_hits == h0) & (s_limit == l0) & (s_duration == d0)
-        & (s_algo == a0) & ~(s_init & (pos > 0))
-    )
-    seg_ok = jnp.ones_like(s_algo).at[seg_start_idx].min(
-        lane_ok.astype(I32), mode="drop")
-    seg_uniform = (seg_ok[seg_start_idx] == 1) & (h0 > 0)
-
-    st_L = cur.limit[seg_start_idx]
-    st_D = cur.duration[seg_start_idx]
-    st_R = cur.remaining[seg_start_idx]
-    st_T = cur.tstamp[seg_start_idx]
-    st_E = cur.expire[seg_start_idx]
-    st_A = cur.algo[seg_start_idx]
-    fresh0 = cur_fresh[seg_start_idx] | (a0 != st_A)
+    `st` is the segment's live register REPLICATED to every lane (the lane's
+    own segment-start register); all math is elementwise over lanes, which
+    is what lets the Pallas lowering (ops/pallas_kernel.py) run it in one
+    VMEM-resident pass.  Returns (final register, per-lane outputs)."""
     is_token0 = a0 == TOKEN_BUCKET
     init_over0 = h0 > l0
 
-    L_eff = jnp.where(fresh0, l0, st_L)
-    D_eff = jnp.where(fresh0, d0, st_D)
+    L_eff = jnp.where(fresh0, l0, st.limit)
+    D_eff = jnp.where(fresh0, d0, st.duration)
     # token: reset_time is now+duration on init, stored otherwise
-    T0_tok = jnp.where(fresh0, now + d0, st_T)
+    T0_tok = jnp.where(fresh0, now + d0, st.tstamp)
     rate0 = jnp.maximum(D_eff // jnp.maximum(l0, jnp.int64(1)), jnp.int64(1))
-    leak0 = jnp.where(fresh0, jnp.int64(0), (now - st_T) // rate0)
-    r_start_tok = jnp.where(fresh0, jnp.where(init_over0, jnp.int64(0), l0), st_R)
+    leak0 = jnp.where(fresh0, jnp.int64(0), (now - st.tstamp) // rate0)
+    r_start_tok = jnp.where(
+        fresh0, jnp.where(init_over0, jnp.int64(0), l0), st.remaining)
     r_start_lky = jnp.where(
         fresh0,
         jnp.where(init_over0, jnp.int64(0), l0),
-        jnp.minimum(st_R + leak0, L_eff),
+        jnp.minimum(st.remaining + leak0, L_eff),
     )
     r_start = jnp.where(is_token0, r_start_tok, r_start_lky)
     kstar = jnp.minimum(seg_len.astype(I64), r_start // h0)
@@ -379,17 +311,160 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
         tstamp=jnp.where(is_token0, T0_tok, now),
         expire=jnp.where(
             is_token0,
-            jnp.where(fresh0, now + d0, st_E),
-            jnp.where(fresh0 | consumed, now + d0, st_E),
+            jnp.where(fresh0, now + d0, st.expire),
+            jnp.where(fresh0 | consumed, now + d0, st.expire),
         ),
         algo=a0,
     )
+    return ff_reg, ff_out
+
+
+class WindowPrep(NamedTuple):
+    """Everything window_step derives from a window before the transition
+    math: sorted request lanes, segment structure, gathered registers, and
+    uniform-segment classification.  Shared verbatim by the XLA path below
+    and the Pallas lowering (ops/pallas_kernel.py) so the two cannot drift.
+    """
+
+    order: jax.Array
+    s_slot: jax.Array
+    s_valid: jax.Array
+    s_hits: jax.Array
+    s_limit: jax.Array
+    s_duration: jax.Array
+    s_algo: jax.Array
+    s_init: jax.Array
+    seg_start: jax.Array
+    seg_start_idx: jax.Array
+    pos: jax.Array
+    seg_len: jax.Array
+    cur: _Reg          # live registers, REPLICATED at every lane
+    fresh_seg: jax.Array  # segment-level miss, replicated (start lane's)
+    h0: jax.Array      # segment-start request fields, replicated
+    l0: jax.Array
+    d0: jax.Array
+    a0: jax.Array
+    seg_uniform: jax.Array
+    max_pos: jax.Array
+
+
+def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
+    """Sort by slot, find segments, gather registers, classify uniform
+    segments (see window_step for the semantics each piece serves)."""
+    B = batch.slot.shape[0]
+    C = state.limit.shape[0]
+
+    valid = batch.slot >= 0
+    # Sort by slot (stable → arrival order preserved within a slot); pads last.
+    sort_key = jnp.where(valid, batch.slot, jnp.int32(2**31 - 1))
+    order = jnp.argsort(sort_key)
+    s_slot = sort_key[order]
+    s_valid = valid[order]
+    s_hits = batch.hits[order]
+    s_limit = batch.limit[order]
+    s_duration = batch.duration[order]
+    s_algo = batch.algo[order]
+    s_init = batch.is_init[order]
+
+    idx = jnp.arange(B, dtype=I32)
+    seg_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), s_slot[1:] != s_slot[:-1]])
+    seg_start_idx = lax.cummax(jnp.where(seg_start, idx, jnp.int32(0)))
+    pos = idx - seg_start_idx
+    # seg_len[i] = length of i's segment: next segment start minus own start
+    shifted = jnp.concatenate([
+        jnp.where(seg_start[1:], idx[1:], jnp.int32(B)),
+        jnp.full((1,), B, I32),
+    ])
+    next_start = jnp.flip(lax.cummin(jnp.flip(shifted)))
+    seg_len = next_start - seg_start_idx
+
+    # Registers: the live state of each segment's bucket.  Every lane of a
+    # segment gathers the SAME slot, so these are replicated per segment.
+    g = jnp.clip(s_slot, 0, C - 1)
+    cur = _Reg(
+        limit=state.limit[g],
+        duration=state.duration[g],
+        remaining=state.remaining[g],
+        tstamp=state.tstamp[g],
+        expire=state.expire[g],
+        algo=state.algo[g],
+    )
+    # Miss conditions known before replay: fresh host allocation or lazy TTL
+    # expiry (lru.go:110: expireAt < now).  Algorithm switches are detected
+    # per-round against the live register.
+    cur_fresh = s_init | (cur.expire < now)
+    fresh_seg = cur_fresh[seg_start_idx]
+
+    # Uniform-segment classification: a hot key's duplicates are usually
+    # identical requests (same hits>0 and config); those take the closed
+    # form (uniform_closed_form).  Only *irregular* segments (mixed
+    # hits/config, zero-hit reads, mid-segment slot recycling) replay.
+    h0 = s_hits[seg_start_idx]
+    l0 = s_limit[seg_start_idx]
+    d0 = s_duration[seg_start_idx]
+    a0 = s_algo[seg_start_idx]
+    lane_ok = (
+        (s_hits == h0) & (s_limit == l0) & (s_duration == d0)
+        & (s_algo == a0) & ~(s_init & (pos > 0))
+    )
+    seg_ok = jnp.ones_like(s_algo).at[seg_start_idx].min(
+        lane_ok.astype(I32), mode="drop")
+    seg_uniform = (seg_ok[seg_start_idx] == 1) & (h0 > 0)
+    max_pos = jnp.max(jnp.where(s_valid & ~seg_uniform, pos, jnp.int32(-1)))
+
+    return WindowPrep(order, s_slot, s_valid, s_hits, s_limit, s_duration,
+                      s_algo, s_init, seg_start, seg_start_idx, pos,
+                      seg_len, cur, fresh_seg, h0, l0, d0, a0, seg_uniform,
+                      max_pos)
+
+
+def window_commit(state: BucketState, prep: WindowPrep, fin: _Reg,
+                  outs_sorted: WindowOutput
+                  ) -> tuple[BucketState, WindowOutput]:
+    """Scatter the final segment registers back to the arena (one write per
+    touched slot — the window's net effect) and un-sort the responses to
+    arrival order.  Shared by the XLA and Pallas paths."""
+    C = state.limit.shape[0]
+    wslot = jnp.where(prep.seg_start & prep.s_valid, prep.s_slot,
+                      jnp.int32(C))
+    new_state = BucketState(
+        limit=state.limit.at[wslot].set(fin.limit, mode="drop"),
+        duration=state.duration.at[wslot].set(fin.duration, mode="drop"),
+        remaining=state.remaining.at[wslot].set(fin.remaining, mode="drop"),
+        tstamp=state.tstamp.at[wslot].set(fin.tstamp, mode="drop"),
+        expire=state.expire.at[wslot].set(fin.expire, mode="drop"),
+        algo=state.algo.at[wslot].set(fin.algo, mode="drop"),
+    )
+    unsorted = WindowOutput(*jax.tree.map(
+        lambda o: jnp.zeros_like(o).at[prep.order].set(o), outs_sorted))
+    return new_state, unsorted
+
+
+def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketState, WindowOutput]:
+    """Apply one window of requests to the arena; returns (new_state, responses).
+
+    Equivalent to the owning node draining one batched GetPeerRateLimits RPC
+    item-by-item under the cache mutex (gubernator.go:210-227,236-251), but as
+    one device computation.  Responses are positionally aligned with the batch
+    (the reference demuxes by index, peers.go:204-207).
+    """
+    B = batch.slot.shape[0]
+    now = jnp.asarray(now, dtype=I64)
+
+    prep = window_prep(state, batch, now)
+    (order, s_slot, s_valid, s_hits, s_limit, s_duration, s_algo, s_init,
+     seg_start, seg_start_idx, pos, seg_len, cur, fresh_seg, h0, l0, d0,
+     a0, seg_uniform, max_pos) = prep
+    cur_fresh = s_init | (cur.expire < now)
+
+    st = _Reg(*jax.tree.map(lambda a: a[seg_start_idx], cur))
+    fresh0 = fresh_seg | (a0 != st.algo)
+    ff_reg, ff_out = uniform_closed_form(
+        st, fresh0, h0, l0, d0, a0, pos, seg_len, now)
 
     # replay buffers start from the fast-path answers; replay rounds only
     # overwrite lanes of non-uniform segments
     outs = ff_out
-
-    max_pos = jnp.max(jnp.where(s_valid & ~seg_uniform, pos, jnp.int32(-1)))
 
     def round_body(carry):
         p, cur, cur_fresh, outs = carry
@@ -422,27 +497,12 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
         round_cond, round_body, (jnp.int32(0), cur, cur_fresh, outs)
     )
 
-    # Commit final segment registers back to the arena (one write per touched
-    # slot — the window's net effect, like the mutex-serialized mutations).
     # Uniform segments commit their closed-form state; replayed segments
-    # commit the live register.
+    # commit the live register (one write per touched slot — the window's
+    # net effect, like the mutex-serialized mutations).
     fin = _Reg(*jax.tree.map(
         lambda f, c: jnp.where(seg_uniform, f, c), ff_reg, cur))
-    wslot = jnp.where(seg_start & s_valid, s_slot, jnp.int32(C))
-    new_state = BucketState(
-        limit=state.limit.at[wslot].set(fin.limit, mode="drop"),
-        duration=state.duration.at[wslot].set(fin.duration, mode="drop"),
-        remaining=state.remaining.at[wslot].set(fin.remaining, mode="drop"),
-        tstamp=state.tstamp.at[wslot].set(fin.tstamp, mode="drop"),
-        expire=state.expire.at[wslot].set(fin.expire, mode="drop"),
-        algo=state.algo.at[wslot].set(fin.algo, mode="drop"),
-    )
-
-    # Un-sort responses back to arrival order.
-    unsorted = WindowOutput(*jax.tree.map(
-        lambda o: jnp.zeros_like(o).at[order].set(o), outs
-    ))
-    return new_state, unsorted
+    return window_commit(state, prep, fin, outs)
 
 
 def pack_outputs(out: WindowOutput, gout: WindowOutput) -> jax.Array:

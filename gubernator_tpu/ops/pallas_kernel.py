@@ -1,21 +1,32 @@
-"""Pallas TPU kernel for the GLOBAL aggregate-apply step.
+"""Pallas TPU kernels for the rate-limit hot passes.
 
-`global_apply` (ops/kernel.py) is a pure elementwise pass over the whole
-replicated GLOBAL arena — six state arrays + config + the psum'd hit totals
-— executed every window.  This module lowers it through Pallas so the pass
-runs as one VMEM-resident kernel (grid-blocked over the arena) instead of an
-XLA fusion chain, and serves as the template for Pallas-lowering the
-per-shard window kernel.
+Two lowerings, chosen by what actually profits from hand-scheduling on TPU
+(everything here is gated behind GUBER_PALLAS=1; the engine defaults to the
+XLA implementations, which are semantically identical):
 
-The kernel body *reuses* `kernel.transition` — the exact branch ladders that
-mirror reference algorithms.go:24-186 — applied to loaded blocks, so Pallas
-and XLA paths cannot drift semantically.
+1. `global_apply_pallas` — the GLOBAL aggregate-apply: a pure elementwise
+   transition over the whole replicated arena, grid-blocked through VMEM.
+
+2. `window_step_pallas` — the per-shard serving window.  The WINDOW MATH
+   (closed-form uniform segments + the duplicate-key replay rounds) runs as
+   ONE VMEM-resident kernel over the [B] lane vectors, with the replay's
+   register state formulated REPLICATED-per-lane so each round is
+   elementwise + one vector gather (no scatters in the kernel).  The
+   argsort and the arena gather/scatter stay in XLA deliberately: Mosaic
+   has no sort primitive, and per-lane DMAs into a 2^27-slot HBM arena
+   lose to XLA's native gather/scatter — a "full" Pallas lowering of those
+   ops would be slower, not faster.
+
+Both kernel bodies *reuse* `kernel.transition` / `kernel.uniform_closed_form`
+— the exact branch ladders that mirror reference algorithms.go:24-186 — so
+the Pallas and XLA paths cannot drift semantically, and the fuzz oracle
+(tests/pyref.py) pins both.
 
 State is int64 (ms-epoch timestamps + proto-contract counters).  Mosaic's
 int64 support on real TPU is not yet validated in this environment (the
 device tunnel was down when this was written), so the engine keeps the XLA
 path by default; enable with GUBER_PALLAS=1 or interpret=True (CPU tests run
-the kernel in interpret mode and pin it against the XLA implementation).
+the kernels in interpret mode and pin them against the XLA implementation).
 """
 
 from __future__ import annotations
@@ -24,10 +35,19 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 
 from gubernator_tpu.ops import kernel
-from gubernator_tpu.ops.kernel import BucketState, GlobalConfig, _Reg
+from gubernator_tpu.ops.kernel import (
+    BucketState,
+    GlobalConfig,
+    WindowBatch,
+    WindowOutput,
+    _Reg,
+    I32,
+    I64,
+)
 
 # lanes per grid step; arenas are sized in powers of two >= 1024
 BLOCK = 1024
@@ -71,10 +91,11 @@ def global_apply_pallas(state: BucketState, cfg: GlobalConfig,
     spec = pl.BlockSpec((block,), lambda i: (i,))
     now_arr = jnp.asarray(now, jnp.int64).reshape((1,))
 
-    # the global arena is replicated across the mesh, so under shard_map the
-    # outputs vary over no axes (vma=()); outside shard_map the annotation is
-    # inert
-    sds = lambda dt: jax.ShapeDtypeStruct((G,), dt, vma=frozenset())
+    # the global arena is replicated across the mesh, so under shard_map
+    # with check_vma the outputs vary over no axes (vma=()); with check_vma
+    # off (the engine's Pallas mode) or outside shard_map, vma is None
+    vma = getattr(jax.typeof(state.limit), "vma", None)
+    sds = lambda dt: jax.ShapeDtypeStruct((G,), dt, vma=vma)
     out_shapes = [sds(jnp.int64)] * 5 + [sds(jnp.int32)]
     outs = pl.pallas_call(
         _apply_kernel,
@@ -91,3 +112,140 @@ def global_apply_pallas(state: BucketState, cfg: GlobalConfig,
     )(now_arr, state.limit, state.duration, state.remaining, state.tstamp,
       state.expire, state.algo, cfg.limit, cfg.duration, cfg.algo, summed_hits)
     return BucketState(*outs)
+
+
+# ---- the serving window kernel ------------------------------------------
+
+
+def _window_math_kernel(now_ref, maxpos_ref,
+                        s_valid, s_hits, s_limit, s_duration, s_algo,
+                        s_init, pos, seg_len, seg_start_idx, seg_uniform,
+                        h0, l0, d0, a0, fresh_seg,
+                        r_lim, r_dur, r_rem, r_ts, r_exp, r_algo,
+                        o_status, o_limit, o_rem, o_reset,
+                        f_lim, f_dur, f_rem, f_ts, f_exp, f_algo):
+    """One VMEM pass over the sorted window: closed-form uniform segments,
+    then replay rounds for irregular ones.
+
+    Register state is REPLICATED at every lane of its segment (the arena
+    gather outside already yields that: all lanes of a segment load the
+    same slot), so a replay round is elementwise plus ONE vector gather —
+    `computed[seg_start + p]` pulls the active lane's freshly-computed
+    register back to every lane of its segment — with no scatters.
+    """
+    now = now_ref[0]
+    max_pos = maxpos_ref[0]
+    B = pos.shape[0]
+
+    reg = _Reg(limit=r_lim[:], duration=r_dur[:], remaining=r_rem[:],
+               tstamp=r_ts[:], expire=r_exp[:], algo=r_algo[:])
+    fresh0 = fresh_seg[:]
+    uniform = seg_uniform[:]
+    valid = s_valid[:]
+    p_arr = pos[:]
+    sidx = seg_start_idx[:]
+
+    # ---- closed form for uniform segments (replicated-register form) ----
+    ff_reg, ff_out = kernel.uniform_closed_form(
+        reg, fresh0 | (a0[:] != reg.algo), h0[:], l0[:], d0[:], a0[:],
+        p_arr, seg_len[:], now)
+
+    # ---- replay rounds for irregular segments ----
+    def body(carry):
+        p, lim, dur, rem, ts, exp, alg, fr, ost, oli, ore, ors = carry
+        r = _Reg(limit=lim, duration=dur, remaining=rem, tstamp=ts,
+                 expire=exp, algo=alg)
+        fresh = fr | (s_algo[:] != r.algo) | s_init[:]
+        new_r, resp = kernel.transition(
+            r, s_hits[:], s_limit[:], s_duration[:], s_algo[:], now, fresh)
+        active = (p_arr == p) & valid & ~uniform
+        # Propagate the active lane's result to its WHOLE segment (the
+        # final commit reads registers at segment-start lanes, pos 0).
+        # ai = my segment start + p; active[ai] holds iff pos[ai] == p,
+        # which algebraically forces sidx[ai] == my sidx — i.e. ai really
+        # is MY segment's round-p lane (the clamp cannot false-positive:
+        # pos[B-1] == p with a clamped ai would need sidx + p > B-1 and
+        # sidx + p == B-1 at once).
+        ai = jnp.clip(sidx + p, 0, B - 1)
+        take = jnp.take(active, ai)
+
+        def upd(new, old):
+            return jnp.where(take, jnp.take(new, ai), old)
+
+        lim = upd(new_r.limit, lim)
+        dur = upd(new_r.duration, dur)
+        rem = upd(new_r.remaining, rem)
+        ts = upd(new_r.tstamp, ts)
+        exp = upd(new_r.expire, exp)
+        alg = jnp.where(take, jnp.take(new_r.algo, ai), alg)
+        fr = jnp.where(take, False, fr)
+        ost = jnp.where(active, resp.status, ost)
+        oli = jnp.where(active, resp.limit, oli)
+        ore = jnp.where(active, resp.remaining, ore)
+        ors = jnp.where(active, resp.reset_time, ors)
+        return (p + 1, lim, dur, rem, ts, exp, alg, fr, ost, oli, ore, ors)
+
+    init = (jnp.int32(0), reg.limit, reg.duration, reg.remaining,
+            reg.tstamp, reg.expire, reg.algo, fresh0,
+            ff_out.status, ff_out.limit, ff_out.remaining,
+            ff_out.reset_time)
+    carry = lax.while_loop(lambda c: c[0] <= max_pos, body, init)
+    (_, lim, dur, rem, ts, exp, alg, _, ost, oli, ore, ors) = carry
+
+    o_status[:] = ost
+    o_limit[:] = oli
+    o_rem[:] = ore
+    o_reset[:] = ors
+    f_lim[:] = jnp.where(uniform, ff_reg.limit, lim)
+    f_dur[:] = jnp.where(uniform, ff_reg.duration, dur)
+    f_rem[:] = jnp.where(uniform, ff_reg.remaining, rem)
+    f_ts[:] = jnp.where(uniform, ff_reg.tstamp, ts)
+    f_exp[:] = jnp.where(uniform, ff_reg.expire, exp)
+    f_algo[:] = jnp.where(uniform, ff_reg.algo, alg)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
+                       interpret: bool = False
+                       ) -> tuple[BucketState, WindowOutput]:
+    """Drop-in replacement for kernel.window_step with the window math in
+    one Pallas kernel.  Sort, segment indexing, the arena gather, and the
+    final scatter/unsort stay in XLA (see the module docstring for why)."""
+    B = batch.slot.shape[0]
+    now = jnp.asarray(now, dtype=I64)
+
+    # identical sort/segment/uniform prep as the XLA path — shared code, so
+    # the two implementations cannot drift
+    prep = kernel.window_prep(state, batch, now)
+    (_, _, s_valid, s_hits, s_limit, s_duration, s_algo, s_init,
+     _, seg_start_idx, pos, seg_len, cur, fresh_seg, h0, l0, d0, a0,
+     seg_uniform, max_pos) = prep
+
+    # under shard_map with check_vma the window arrays vary over the shard
+    # axis; mirror the input's vma on the outputs.  The engine disables
+    # check_vma on its shard_maps when Pallas is enabled (vma tags do not
+    # survive the kernel's interpret-mode while_loop), in which case typeof
+    # has no vma and None is correct.
+    vma = getattr(jax.typeof(batch.slot), "vma", None)
+    sds = lambda dt: jax.ShapeDtypeStruct((B,), dt, vma=vma)
+    spec = pl.BlockSpec((B,), lambda: (0,))
+    sspec = pl.BlockSpec((1,), lambda: (0,))
+    outs = pl.pallas_call(
+        _window_math_kernel,
+        in_specs=[sspec, sspec] + [spec] * 21,
+        out_specs=[spec] * 10,
+        out_shape=[sds(I32), sds(I64), sds(I64), sds(I64),   # outputs
+                   sds(I64), sds(I64), sds(I64), sds(I64), sds(I64),
+                   sds(I32)],                                 # final regs
+        interpret=interpret,
+    )(now.reshape((1,)), max_pos.reshape((1,)),
+      s_valid, s_hits, s_limit, s_duration, s_algo, s_init,
+      pos, seg_len, seg_start_idx, seg_uniform,
+      h0, l0, d0, a0, fresh_seg,
+      cur.limit, cur.duration, cur.remaining, cur.tstamp, cur.expire,
+      cur.algo)
+    out_sorted = WindowOutput(status=outs[0], limit=outs[1],
+                              remaining=outs[2], reset_time=outs[3])
+    fin = _Reg(limit=outs[4], duration=outs[5], remaining=outs[6],
+               tstamp=outs[7], expire=outs[8], algo=outs[9])
+    return kernel.window_commit(state, prep, fin, out_sorted)
