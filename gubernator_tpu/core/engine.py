@@ -1162,6 +1162,16 @@ class RateLimitEngine:
             buf.ptstamp, buf.pexpire, buf.palgo))
         now_in = self._repl_in(np.int64(now)) if self.multiprocess \
             else jnp.int64(now)
+        # SURVEY §5 tracing analog: window dispatches show up as named steps
+        # in a jax.profiler trace (GUBER_PROFILE in bench.py, or any
+        # profiler session); no-op otherwise
+        with jax.profiler.StepTraceAnnotation(
+                "guber_window", step_num=self.windows_processed):
+            return self._dispatch_inner(buf, compact, lanes, gbatch, gacc,
+                                        upd, ups, now, now_in, fetch_global)
+
+    def _dispatch_inner(self, buf, compact, lanes, gbatch, gacc, upd, ups,
+                        now, now_in, fetch_global):
         if compact:
             packed = self._sharded_in(kernel.encode_batch_host(
                 buf.slot[:, :lanes], buf.hits[:, :lanes],
@@ -1213,7 +1223,9 @@ class RateLimitEngine:
                 "the dispatch pipeline is standalone-only; mesh serving "
                 "dispatches on the lockstep clock")
         fn = _compiled_pipeline_step(self.mesh)
-        self.state, words, limits, mism = fn(self.state, packed, nows)
+        with jax.profiler.StepTraceAnnotation(
+                "guber_drain", step_num=self.windows_processed):
+            self.state, words, limits, mism = fn(self.state, packed, nows)
         self.windows_processed += (int(packed.shape[0]) if n_windows is None
                                    else n_windows)
         return words, limits, mism
