@@ -861,6 +861,20 @@ class RateLimitEngine:
         """
         if not compact_safe:
             self._compact_enabled = False
+        k = int(batches.slot.shape[0])
+        if n_decisions is None:
+            if (isinstance(batches.slot, np.ndarray)
+                    and isinstance(gbatches.slot, np.ndarray)):
+                # host staging (counted BEFORE any mesh rebind to sharded
+                # arrays): occupied regular + GLOBAL lanes, exactly —
+                # matching what process()/step() count for the same traffic
+                n_decisions = (int((batches.slot >= 0).sum())
+                               + int((gbatches.slot >= 0).sum()))
+            else:
+                # resident device arrays: the real count isn't host-visible
+                # without a fetch — callers with partially-filled resident
+                # stacks should pass n_decisions to keep the counter honest
+                n_decisions = k * int(np.prod(batches.slot.shape[1:]))
         if self.multiprocess:
             batches = WindowBatch(*[self._sharded_in_stacked(np.asarray(a))
                                     for a in batches])
@@ -874,14 +888,7 @@ class RateLimitEngine:
             self.state, self.gstate, self.gcfg, batches, gbatches, gaccs,
             upd, ups, nows,
         )
-        k = int(batches.slot.shape[0])
         self.windows_processed += k
-        if n_decisions is None:
-            # lane-capacity fallback: the stacked inputs may be resident
-            # device arrays, so the real request count (slot != PAD_SLOT) is
-            # not host-visible here — callers with partially-filled stacks
-            # should pass n_decisions to keep the throughput counter honest
-            n_decisions = k * int(np.prod(batches.slot.shape[1:]))
         self.decisions_processed += n_decisions
         return fused
 

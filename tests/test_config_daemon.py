@@ -109,3 +109,33 @@ def test_daemon_end_to_end(clean_env):
             await d.stop()
 
     asyncio.new_event_loop().run_until_complete(body())
+
+
+def test_lockstep_stack_env(clean_env):
+    clean_env.setenv("GUBER_LOCKSTEP_STACK", "4")
+    c = config_from_env()
+    assert c.behaviors.lockstep_stack == 4
+
+
+def test_lockstep_stack_invalid(clean_env):
+    clean_env.setenv("GUBER_LOCKSTEP_STACK", "0")
+    with pytest.raises(ValueError):
+        config_from_env()
+
+
+def test_exact_keys_engine_plumb(clean_env):
+    """EngineConfig.exact_keys reaches the native router (storage arrays
+    allocated; behavior covered by the differential in
+    test_native_router.py)."""
+    from gubernator_tpu import native
+    if not native.available():
+        pytest.skip("native router unavailable")
+    from gubernator_tpu.core.engine import RateLimitEngine
+    eng = RateLimitEngine(capacity_per_shard=32, batch_per_shard=8,
+                          global_capacity=8, global_batch_per_shard=4,
+                          max_global_updates=4, exact_keys=True)
+    assert eng.native is not None
+    from gubernator_tpu.api.types import RateLimitReq
+    r = eng.process([RateLimitReq(name="x", unique_key="k", hits=1,
+                                  limit=5, duration=1000)], now=1)[0]
+    assert r.remaining == 4
