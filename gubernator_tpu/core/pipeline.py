@@ -314,6 +314,9 @@ class DispatchPipeline:
         # observability: RPCs fully served by this lane (tests assert the
         # lane actually engaged rather than silently falling back)
         self.rpc_served = 0
+        # strong refs to in-flight forward tasks (the loop keeps only weak
+        # ones)
+        self._fwd_tasks: set = set()
 
     def install_ring(self, points, peer_of, peers, self_idx) -> None:
         """Install the cluster ring (engine thread): the C parser's point
@@ -420,59 +423,90 @@ class DispatchPipeline:
             self._pump()
             return
         # start forwards for cluster-mode mixed RPCs NOW, so the peer round
-        # trips overlap the local stack's fetch
-        for job in res.staged:
-            if isinstance(job, RpcJob) and len(job.remote_idx):
-                job.forward_task = self._loop.create_task(
-                    self._forward_remote(job, res.ring_peers))
+        # trips overlap the local stack's fetch.  Forwards COALESCE across
+        # every mixed RPC of the drain: one relay per owner per drain (the
+        # reference aggregates per-peer across requests the same way,
+        # peers.go:143-172)
+        mixed = [j for j in res.staged
+                 if isinstance(j, RpcJob) and len(j.remote_idx)]
+        if mixed:
+            self._spawn_forwards(mixed, res.ring_peers)
         cfut = self._loop.run_in_executor(self._fetch_executor,
                                           self._complete_sync, res)
         cfut.add_done_callback(lambda f: self._on_completed(f, res))
         # a second drain may dispatch while this one's fetch is in flight
         self._pump()
 
-    async def _forward_remote(self, job: RpcJob, ring_peers):
-        """Forward a mixed RPC's remote items to their ring owners as
-        spliced BYTES: per owner, the items' serialized RateLimitReq frames
-        concatenate into one GetPeerRateLimitsReq (same field-1 framing),
-        and the owner's framed responses come back positionally — the
-        reference's batch relay (peers.go:176-207) without materializing a
-        single Python protobuf object.  Returns {item_index: framed
-        RateLimitResp bytes} with per-item error semantics."""
+    def _spawn_forwards(self, jobs: List[RpcJob], ring_peers) -> None:
+        """Forward the drain's remote items to their ring owners as spliced
+        BYTES: per owner, every mixed RPC's serialized RateLimitReq frames
+        concatenate into one GetPeerRateLimitsReq (same field-1 framing) —
+        the reference's per-peer batch relay (peers.go:143-207) without
+        materializing a single Python protobuf object.  Each job's
+        forward_task resolves ({item_index: framed RateLimitResp bytes},
+        per-item error semantics) as soon as ITS items are answered, so one
+        slow owner delays only the RPCs that actually touched it."""
         from gubernator_tpu.api import pb
 
-        by_owner = {}
-        for i in job.remote_idx.tolist():
-            by_owner.setdefault(-2 - int(job.row[i]), []).append(i)
+        by_owner: dict = {}
+        pending: dict = {}
+        results: dict = {}
+        for job in jobs:
+            job.forward_task = self._loop.create_future()
+            pending[id(job)] = len(job.remote_idx)
+            results[id(job)] = {}
+            for i in job.remote_idx.tolist():
+                by_owner.setdefault(-2 - int(job.row[i]),
+                                    []).append((job, int(i)))
 
-        out = {}
+        def deliver(job, i, frame):
+            jid = id(job)
+            results[jid][i] = frame
+            pending[jid] -= 1
+            if pending[jid] == 0 and not job.forward_task.done():
+                job.forward_task.set_result(results[jid])
 
-        async def one_owner(owner_idx, idxs):
-            peer = ring_peers[owner_idx]
-            body = b"".join(
-                b"\x0a" + _varint(int(job.mlen[i]))
-                + job.data[int(job.off[i]):int(job.off[i]) + int(job.mlen[i])]
-                for i in idxs)
+        async def one_chunk(owner_idx, items):
+            # EVERYTHING is inside the try: forward_task has no
+            # set_exception path by design (the error contract is
+            # per-item), so any escape here — bad owner index from a
+            # shrunk ring, corrupt staging values — would otherwise leave
+            # the jobs' futures unresolved forever
+            peer = None
             try:
+                peer = ring_peers[owner_idx]
+                body = b"".join(
+                    b"\x0a" + _varint(int(job.mlen[i]))
+                    + job.data[int(job.off[i]):
+                               int(job.off[i]) + int(job.mlen[i])]
+                    for job, i in items)
                 resp = await peer.get_peer_rate_limits_raw(body)
                 frames = _walk_frames(resp)
-                if len(frames) != len(idxs):
+                if len(frames) != len(items):
                     raise RuntimeError(
                         "number of rate limits in peer response does not "
                         "match request")
-                for i, fr in zip(idxs, frames):
-                    out[i] = _append_owner(fr, peer.host)
+                for (job, i), fr in zip(items, frames):
+                    deliver(job, i, _append_owner(fr, peer.host))
             except Exception as e:  # noqa: BLE001 — per-item error contract
+                host = getattr(peer, "host", f"ring#{owner_idx}")
                 err = pb.RateLimitResp(
                     error=(f"while fetching rate limit from peer "
-                           f"{peer.host} - '{e}'")).SerializeToString()
+                           f"{host} - '{e}'")).SerializeToString()
                 fr = _frame(err)
-                for i in idxs:
-                    out[i] = fr
+                for job, i in items:
+                    deliver(job, i, fr)
 
-        await asyncio.gather(*(one_owner(o, idxs)
-                               for o, idxs in by_owner.items()))
-        return out
+        for owner_idx, items in by_owner.items():
+            # the owner enforces the reference's 1000-item RPC cap
+            for base in range(0, len(items), MAX_BATCH_SIZE):
+                t = self._loop.create_task(
+                    one_chunk(owner_idx, items[base:base + MAX_BATCH_SIZE]))
+                # the loop holds only weak refs to tasks; anchor them so GC
+                # cannot collect an in-flight forward (a collected task
+                # would hang its jobs' futures)
+                self._fwd_tasks.add(t)
+                t.add_done_callback(self._fwd_tasks.discard)
 
     def _on_completed(self, fut, res: _DrainResult) -> None:
         self._in_flight -= 1
