@@ -314,9 +314,15 @@ class DispatchPipeline:
         # observability: RPCs fully served by this lane (tests assert the
         # lane actually engaged rather than silently falling back)
         self.rpc_served = 0
-        # strong refs to in-flight forward tasks (the loop keeps only weak
-        # ones)
-        self._fwd_tasks: set = set()
+        # strong refs to every in-flight delivery-path task (the loop keeps
+        # only weak ones; a GC'd task would hang the futures it owes)
+        self._tasks: set = set()
+
+    def _spawn(self, coro) -> None:
+        """create_task with a strong reference held until completion."""
+        t = self._loop.create_task(coro)
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
 
     def install_ring(self, points, peer_of, peers, self_idx) -> None:
         """Install the cluster ring (engine thread): the C parser's point
@@ -488,7 +494,10 @@ class DispatchPipeline:
                         "match request")
                 for (job, i), fr in zip(items, frames):
                     deliver(job, i, _append_owner(fr, peer.host))
-            except Exception as e:  # noqa: BLE001 — per-item error contract
+            except BaseException as e:  # noqa: BLE001 — nothing may
+                # escape without resolving the chunk's items: even
+                # CancelledError (a BaseException) would otherwise strand
+                # the jobs' forward futures forever
                 host = getattr(peer, "host", f"ring#{owner_idx}")
                 err = pb.RateLimitResp(
                     error=(f"while fetching rate limit from peer "
@@ -496,17 +505,14 @@ class DispatchPipeline:
                 fr = _frame(err)
                 for job, i in items:
                     deliver(job, i, fr)
+                if isinstance(e, asyncio.CancelledError):
+                    raise
 
         for owner_idx, items in by_owner.items():
             # the owner enforces the reference's 1000-item RPC cap
             for base in range(0, len(items), MAX_BATCH_SIZE):
-                t = self._loop.create_task(
+                self._spawn(
                     one_chunk(owner_idx, items[base:base + MAX_BATCH_SIZE]))
-                # the loop holds only weak refs to tasks; anchor them so GC
-                # cannot collect an in-flight forward (a collected task
-                # would hang its jobs' futures)
-                self._fwd_tasks.add(t)
-                t.add_done_callback(self._fwd_tasks.discard)
 
     def _on_completed(self, fut, res: _DrainResult) -> None:
         self._in_flight -= 1
@@ -522,8 +528,7 @@ class DispatchPipeline:
             if isinstance(job, RpcJob):
                 self.rpc_served += 1
                 if job.forward_task is not None:
-                    self._loop.create_task(
-                        self._assemble_mixed(job, out, res.now))
+                    self._spawn(self._assemble_mixed(job, out, res.now))
                 elif not job.fut.done():
                     job.fut.set_result(out)
             elif job.futs is not None:
@@ -579,7 +584,7 @@ class DispatchPipeline:
                         f.set_result(r)
             elif not job.fut.done():
                 job.fut.set_result(resps)
-        self._loop.create_task(run())
+        self._spawn(run())
 
     def _resolve_error(self, job, err: Exception) -> None:
         futs = ([job.fut] if getattr(job, "futs", None) is None

@@ -1,0 +1,69 @@
+"""Byte-splicing helpers for the clustered RPC lane (core/pipeline.py):
+frame walking, re-framing, and the metadata['owner'] append must round-trip
+through the real protobuf codec — the forwarding path never materializes
+message objects, so these are the wire contract."""
+
+import pytest
+
+import gubernator_tpu  # noqa: F401
+from gubernator_tpu.api import pb
+from gubernator_tpu.core.pipeline import (
+    _append_owner,
+    _frame,
+    _varint,
+    _walk_frames,
+)
+
+
+def test_varint_matches_protobuf():
+    for v in (1, 127, 128, 300, 2 ** 21, 2 ** 35):
+        msg = pb.RateLimitResp(limit=v).SerializeToString()
+        # field 2 tag then the varint (proto3 omits zero values entirely,
+        # so 0 has no on-wire encoding to compare against)
+        assert msg[1:] == _varint(v)
+    assert _varint(0) == b"\x00"
+
+
+def test_walk_frames_roundtrip():
+    resps = [pb.RateLimitResp(status=i % 2, limit=10 * i, remaining=i,
+                              reset_time=1_700_000_000_000 + i)
+             for i in range(5)]
+    data = pb.GetRateLimitsResp(responses=resps).SerializeToString()
+    frames = _walk_frames(data)
+    assert len(frames) == 5
+    # each frame re-parses standalone and concatenation reproduces the
+    # original message
+    assert b"".join(frames) == data
+    for i, fr in enumerate(frames):
+        one = pb.GetRateLimitsResp.FromString(fr)
+        assert one.responses[0].remaining == i
+
+
+def test_walk_frames_skips_unknown_fields():
+    # unknown varint field 9 between entries must be skipped, not crash
+    body = pb.RateLimitResp(limit=7).SerializeToString()
+    data = _frame(body) + b"\x48\x2a" + _frame(body)
+    frames = _walk_frames(data)
+    assert len(frames) == 2
+
+
+def test_walk_frames_rejects_unsupported_wire_type():
+    with pytest.raises(ValueError):
+        _walk_frames(b"\x0d\x00\x00\x00\x00")  # fixed32 wire type
+
+
+def test_append_owner_metadata():
+    body = pb.RateLimitResp(status=1, limit=5, remaining=2).SerializeToString()
+    fr = _append_owner(_frame(body), "10.0.0.7:81")
+    msg = pb.GetRateLimitsResp.FromString(fr)
+    r = msg.responses[0]
+    assert (r.status, r.limit, r.remaining) == (1, 5, 2)
+    assert r.metadata["owner"] == "10.0.0.7:81"
+
+
+def test_append_owner_preserves_existing_metadata():
+    m = pb.RateLimitResp(limit=3)
+    m.metadata["trace"] = "abc"
+    fr = _append_owner(_frame(m.SerializeToString()), "h:1")
+    r = pb.GetRateLimitsResp.FromString(fr).responses[0]
+    assert r.metadata == {"trace": "abc", "owner": "h:1"}
