@@ -505,8 +505,8 @@ class DispatchPipeline:
                 fr = _frame(err)
                 for job, i in items:
                     deliver(job, i, fr)
-                if isinstance(e, asyncio.CancelledError):
-                    raise
+                if not isinstance(e, Exception):
+                    raise  # CancelledError / KeyboardInterrupt / SystemExit
 
         for owner_idx, items in by_owner.items():
             # the owner enforces the reference's 1000-item RPC cap
@@ -561,9 +561,15 @@ class DispatchPipeline:
                     parts.append(fwd[i])
             if not job.fut.done():
                 job.fut.set_result(b"".join(parts))
-        except Exception as e:  # noqa: BLE001
+        except BaseException as e:  # noqa: BLE001 — a cancelled task must
+            # still resolve the RPC future it owes (same contract as
+            # one_chunk), then let non-Exception signals propagate
             if not job.fut.done():
-                job.fut.set_exception(e)
+                job.fut.set_exception(
+                    e if isinstance(e, Exception)
+                    else RuntimeError(f"pipeline shutdown ({type(e).__name__})"))
+            if not isinstance(e, Exception):
+                raise
 
     def _route_fallback(self, job) -> None:
         if isinstance(job, RpcJob):
@@ -575,8 +581,14 @@ class DispatchPipeline:
         async def run():
             try:
                 resps = await self.legacy(job.reqs)
-            except Exception as e:
-                self._resolve_error(job, e)
+            except BaseException as e:  # noqa: BLE001 — a cancelled task
+                # must still resolve the futures it owes, then let
+                # non-Exception signals propagate
+                self._resolve_error(
+                    job, e if isinstance(e, Exception) else RuntimeError(
+                        f"pipeline shutdown ({type(e).__name__})"))
+                if not isinstance(e, Exception):
+                    raise
                 return
             if job.futs is not None:
                 for f, r in zip(job.futs, resps):

@@ -1,0 +1,83 @@
+"""Chaos: peer death mid-flight (SURVEY §4 gap — the reference has no such
+test).  A 3-node cluster keeps serving its own keys with per-item error
+semantics while one peer is down, and heals when membership catches up."""
+
+import asyncio
+
+import grpc
+import pytest
+
+import gubernator_tpu  # noqa: F401
+from gubernator_tpu import cluster as cluster_mod
+from gubernator_tpu.api import pb
+from gubernator_tpu.config import PeerInfo
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def run(loop, coro):
+    return loop.run_until_complete(asyncio.wait_for(coro, timeout=120))
+
+
+def _payload(n, name="chaos"):
+    return pb.GetRateLimitsReq(requests=[
+        pb.RateLimitReq(name=name, unique_key=f"k{i}", hits=1,
+                        limit=1_000, duration=60_000)
+        for i in range(n)
+    ]).SerializeToString()
+
+
+def test_peer_death_then_heal(loop):
+    async def body():
+        c = await cluster_mod.start(3)
+        chan = grpc.aio.insecure_channel(c.peer_at(0))
+        raw = chan.unary_unary(
+            "/pb.gubernator.V1/GetRateLimits",
+            request_serializer=lambda b: b,
+            response_deserializer=pb.GetRateLimitsResp.FromString)
+        inst0 = c.instance_at(0)
+        owners = {f"k{i}": c.nodes.index(next(
+            n for n in c.nodes
+            if n.instance.advertise_address == inst0.get_peer(
+                f"chaos_k{i}").host)) for i in range(100)}
+
+        resp = await raw(_payload(100))
+        assert all(not r.error for r in resp.responses)
+
+        # ---- kill node 2 hard (server stops; keys it owned now fail) ----
+        dead = 2
+        await c.nodes[dead].server.stop(grace=0)
+        c.nodes[dead].instance.close()
+        resp = await raw(_payload(100))
+        for i, r in enumerate(resp.responses):
+            if owners[f"k{i}"] == dead:
+                assert r.error, f"k{i} owned by dead node must error"
+            else:
+                assert not r.error, (f"k{i}", r.error)
+
+        # ---- membership update without the dead peer: all keys serve ----
+        live = [n.instance.advertise_address
+                for j, n in enumerate(c.nodes) if j != dead]
+        for j, n in enumerate(c.nodes):
+            if j == dead:
+                continue
+            await n.instance.set_peers([
+                PeerInfo(address=a,
+                         is_owner=(a == n.instance.advertise_address))
+                for a in live])
+        resp = await raw(_payload(100))
+        assert all(not r.error for r in resp.responses)
+
+        await chan.close()
+        # close the survivors only (node 2 is already closed)
+        for j, n in enumerate(c.nodes):
+            if j != dead:
+                await n.server.stop(grace=0.2)
+                n.instance.close()
+
+    run(loop, body())
