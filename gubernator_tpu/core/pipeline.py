@@ -1,5 +1,5 @@
 """Pipelined serving drain: pending work → stacked compact windows → one
-async dispatch → fetch on a separate thread.
+async dispatch → fetch on a small worker pool.
 
 Why this shape (measured on the round-4 transfer probe, tunneled v5e; the
 same structure is what PCIe wants, just with smaller constants):
@@ -19,10 +19,13 @@ maximizes the numerator and hides the denominator:
      sequential per-key order through the device-side scan);
   2. the stack dispatches as one executable call (engine.pipeline_dispatch)
      that returns un-fetched device arrays;
-  3. a dedicated fetch thread materializes the response words and demuxes
-     them (C proto encode for RPC jobs, vectorized numpy for list jobs)
-     while the engine thread is already packing and dispatching the NEXT
-     drain.
+  3. a small fetch pool (two workers — outstanding device→host fetches
+     overlap partially, measured ~2x) materializes the response words and
+     demuxes them (C proto encode for RPC jobs, vectorized numpy for list
+     jobs) while the engine thread is already packing and dispatching the
+     NEXT drain.  Demux per drain is self-contained (stateless C encoders
+     over caller buffers), so completing out of order is safe; per-key
+     ordering was committed at dispatch on the engine thread.
 
 Reference analog: a peer draining its queue ships batches back-to-back
 without waiting for each response (peers.go:143-172); the reference's
@@ -274,7 +277,7 @@ class DispatchPipeline:
 
     def __init__(self, engine, engine_executor: ThreadPoolExecutor,
                  metrics=None, k_max: int = PIPELINE_K_BUCKETS[-1],
-                 depth: int = 2):
+                 depth: int = 3):
         self.engine = engine
         self.enabled = (engine.native is not None
                         and not engine.multiprocess)
@@ -305,8 +308,12 @@ class DispatchPipeline:
         self._closed = False
         if not self.enabled:
             return
+        # TWO fetch workers: outstanding device→host fetches overlap
+        # partially (measured ~2x on the tunneled chip), and each drain's
+        # demux is independent so out-of-order completion is safe — per-key
+        # ordering was already committed at dispatch
         self._fetch_executor = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="guber-fetch")
+            max_workers=2, thread_name_prefix="guber-fetch")
         self._singles: List[tuple] = []   # (req, fut)
         self._jobs: List[object] = []     # FIFO of RpcJob/ListJob
         self._in_flight = 0

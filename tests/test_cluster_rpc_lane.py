@@ -145,3 +145,38 @@ def test_rpc_lane_matches_slow_path_across_nodes(cluster, loop):
             await c.close()
 
     run(loop, body())
+
+
+def test_rpc_lane_all_items_remote(cluster, loop):
+    """An RPC whose EVERY item belongs to other peers: the drain stages
+    nothing locally (no dispatch), yet the spliced forwards still produce a
+    positionally-exact response."""
+    async def body():
+        inst0 = cluster.instance_at(0)
+        remote_keys = []
+        i = 0
+        while len(remote_keys) < 120:
+            k = f"ar{i}"
+            if not inst0.get_peer(f"rlane2_{k}").is_owner:
+                remote_keys.append(k)
+            i += 1
+        payload = pb.GetRateLimitsReq(requests=[
+            pb.RateLimitReq(name="rlane2", unique_key=k, hits=1, limit=50,
+                            duration=60_000) for k in remote_keys
+        ]).SerializeToString()
+        assert len(payload) >= 2048  # rides the RPC lane
+        chan = grpc.aio.insecure_channel(cluster.peer_at(0))
+        raw = chan.unary_unary(
+            "/pb.gubernator.V1/GetRateLimits",
+            request_serializer=lambda b: b,
+            response_deserializer=pb.GetRateLimitsResp.FromString)
+        r1 = await raw(payload)
+        r2 = await raw(payload)
+        assert len(r1.responses) == 120
+        for a, b in zip(r1.responses, r2.responses):
+            assert not a.error and not b.error, (a.error, b.error)
+            assert a.remaining == 49 and b.remaining == 48, (a, b)
+            assert "owner" in b.metadata
+        await chan.close()
+
+    run(loop, body())
