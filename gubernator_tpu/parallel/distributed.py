@@ -29,7 +29,6 @@ Env surface (daemon wiring):
 from __future__ import annotations
 
 import os
-from typing import Optional
 
 import jax
 import numpy as np
