@@ -5,8 +5,11 @@ workload hypothesis can dream up, with shrinking to minimal
 counterexamples.  Complements the fixed-seed fuzz in test_engine.py."""
 
 import pytest
-from hypothesis import HealthCheck, given, settings
-from hypothesis import strategies as st
+
+hypothesis = pytest.importorskip(
+    "hypothesis")  # test-only dependency, not in the runtime image
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 import gubernator_tpu  # noqa: F401
 from gubernator_tpu import native
