@@ -567,8 +567,61 @@ def bench_e2e(mesh, capacity, lanes, seconds=5.0, concurrency=32):
     return asyncio.run(run())
 
 
+def bench_pallas_probe(on_cpu):
+    """Attempt ONE Pallas-lowered window on the real backend and record
+    whether Mosaic accepts the int64 kernel (PARITY known gap: unvalidated
+    while the tunnel was down).  Interpret mode on CPU == trivially true,
+    so only the TPU answer is informative."""
+    try:
+        import numpy as np
+
+        from gubernator_tpu.ops import kernel
+        from gubernator_tpu.ops.pallas_kernel import window_step_pallas
+
+        state = kernel.BucketState.zeros(1024)
+        rng = np.random.default_rng(3)
+        slots = rng.integers(0, 1024, 256).astype(np.int32)
+        batch = kernel.WindowBatch(
+            slot=slots, hits=np.ones(256, np.int64),
+            limit=np.full(256, 100, np.int64),
+            duration=np.full(256, 60_000, np.int64),
+            algo=(slots % 2).astype(np.int32),
+            is_init=np.ones(256, bool))
+        t0 = time.perf_counter()
+        new_state, out = window_step_pallas(state, batch,
+                                            1_700_000_000_000,
+                                            interpret=on_cpu)
+        import jax
+        jax.block_until_ready(out.status)
+        # spot-check against the XLA path
+        _, want = kernel.window_step(kernel.BucketState.zeros(1024), batch,
+                                     1_700_000_000_000)
+        ok = bool((np.asarray(out.remaining) ==
+                   np.asarray(want.remaining)).all())
+        log(f"# pallas probe: {'ok' if ok else 'MISMATCH'} "
+            f"({time.perf_counter() - t0:.1f}s incl. compile, "
+            f"interpret={on_cpu})")
+        return {"pallas_window_ok": ok}
+    except Exception as e:  # noqa: BLE001 — record, don't fail the bench
+        log(f"# pallas probe failed: {type(e).__name__}: {e}")
+        return {"pallas_window_ok": False,
+                "pallas_error": f"{type(e).__name__}: {str(e)[:200]}"}
+
+
 def child_main():
     result = {}
+
+    def checkpoint():
+        """Persist the tiers measured so far: a hang in a LATER tier must
+        not cost the numbers already captured (the parent kills the child
+        at the wall budget and reads whatever was last written).  Atomic
+        via rename — a SIGKILL mid-write must not truncate the last good
+        checkpoint."""
+        tmp = os.environ[OUT_ENV] + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(result))
+        os.replace(tmp, os.environ[OUT_ENV])
+
     try:
         devs = acquire_backend()
         import jax
@@ -605,15 +658,18 @@ def child_main():
         result["device_decisions_per_sec"] = round(dev_ps, 1)
         result["window_p50_ms"] = round(p50_ms, 3)
         result["window_p99_ms"] = round(p99_ms, 3)
+        checkpoint()
 
         host_ps = bench_host_pipeline(mesh, capacity, lanes,
                                       seconds=3.0 if on_cpu else 5.0,
                                       concurrency=32 if on_cpu else 256)
         result["host_decisions_per_sec"] = round(host_ps, 1)
+        checkpoint()
 
         sync_ps = bench_host_sync(mesh, capacity, lanes,
                                   seconds=2.0 if on_cpu else 3.0)
         result["host_sync_decisions_per_sec"] = round(sync_ps, 1)
+        checkpoint()
 
         e2e_ps, ping_p50, herd_rps, herd_p99 = bench_e2e(
             mesh, capacity, lanes, seconds=3.0 if on_cpu else 5.0,
@@ -627,15 +683,18 @@ def child_main():
         # the 2^27 arena must not zero a measured e2e number
         result["value"] = round(e2e_ps, 1)
         result["vs_baseline"] = round(e2e_ps / BASELINE_REQS_PER_SEC, 2)
+        checkpoint()
 
         result.update(bench_bigkeys(mesh, on_cpu,
                                     seconds=3.0 if on_cpu else 5.0))
+        checkpoint()
+
+        result.update(bench_pallas_probe(on_cpu))
     except Exception as e:  # noqa: BLE001 — the parent still prints JSON
         import traceback
         traceback.print_exc()
         result["error"] = f"{type(e).__name__}: {e}"
-    with open(os.environ[OUT_ENV], "w") as f:
-        f.write(json.dumps(result))
+    checkpoint()
 
 
 if __name__ == "__main__":
