@@ -346,11 +346,23 @@ class WindowPrep(NamedTuple):
     a0: jax.Array
     seg_uniform: jax.Array
     max_pos: jax.Array
+    commit_mask: jax.Array  # lanes whose register commits to the arena
 
 
 def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
     """Sort by slot, find segments, gather registers, classify uniform
-    segments (see window_step for the semantics each piece serves)."""
+    segments (see window_step for the semantics each piece serves).
+
+    Segments are VIRTUAL: they break at slot changes AND at is_init lanes.
+    Capacity eviction can recycle a slot to a different key mid-window
+    (state/arena.py + native pack assign the new tenant's first lane
+    is_init); splitting there turns [old-tenant lanes][init + new-tenant
+    lanes] into two independently-uniform segments, so a recycled hot slot
+    keeps the closed form instead of forcing a lane-by-lane replay of the
+    whole run (a 3000-duplicate Zipf head key would otherwise cost 3000
+    replay rounds in one device call).  Only the LAST virtual segment of a
+    slot commits to the arena (earlier tenants' counters die with the
+    eviction, exactly like the reference's cache Remove)."""
     B = batch.slot.shape[0]
     C = state.limit.shape[0]
 
@@ -367,7 +379,9 @@ def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
     s_init = batch.is_init[order]
 
     idx = jnp.arange(B, dtype=I32)
-    seg_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), s_slot[1:] != s_slot[:-1]])
+    phys_start = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), s_slot[1:] != s_slot[:-1]])
+    seg_start = phys_start | (s_init & s_valid)
     seg_start_idx = lax.cummax(jnp.where(seg_start, idx, jnp.int32(0)))
     pos = idx - seg_start_idx
     # seg_len[i] = length of i's segment: next segment start minus own start
@@ -377,6 +391,15 @@ def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
     ])
     next_start = jnp.flip(lax.cummin(jnp.flip(shifted)))
     seg_len = next_start - seg_start_idx
+    # next PHYSICAL boundary after each lane: a virtual segment is its
+    # slot's last (→ the one that commits) iff no further virtual start
+    # precedes the next slot change
+    shifted_p = jnp.concatenate([
+        jnp.where(phys_start[1:], idx[1:], jnp.int32(B)),
+        jnp.full((1,), B, I32),
+    ])
+    next_phys = jnp.flip(lax.cummin(jnp.flip(shifted_p)))
+    commit_mask = seg_start & s_valid & (next_start >= next_phys)
 
     # Registers: the live state of each segment's bucket.  Every lane of a
     # segment gathers the SAME slot, so these are replicated per segment.
@@ -398,14 +421,15 @@ def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
     # Uniform-segment classification: a hot key's duplicates are usually
     # identical requests (same hits>0 and config); those take the closed
     # form (uniform_closed_form).  Only *irregular* segments (mixed
-    # hits/config, zero-hit reads, mid-segment slot recycling) replay.
+    # hits/config, zero-hit reads) replay — is_init lanes can't appear
+    # mid-segment anymore (they start their own virtual segment above).
     h0 = s_hits[seg_start_idx]
     l0 = s_limit[seg_start_idx]
     d0 = s_duration[seg_start_idx]
     a0 = s_algo[seg_start_idx]
     lane_ok = (
         (s_hits == h0) & (s_limit == l0) & (s_duration == d0)
-        & (s_algo == a0) & ~(s_init & (pos > 0))
+        & (s_algo == a0)
     )
     seg_ok = jnp.ones_like(s_algo).at[seg_start_idx].min(
         lane_ok.astype(I32), mode="drop")
@@ -415,7 +439,7 @@ def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
     return WindowPrep(order, s_slot, s_valid, s_hits, s_limit, s_duration,
                       s_algo, s_init, seg_start, seg_start_idx, pos,
                       seg_len, cur, fresh_seg, h0, l0, d0, a0, seg_uniform,
-                      max_pos)
+                      max_pos, commit_mask)
 
 
 def window_commit(state: BucketState, prep: WindowPrep, fin: _Reg,
@@ -423,10 +447,14 @@ def window_commit(state: BucketState, prep: WindowPrep, fin: _Reg,
                   ) -> tuple[BucketState, WindowOutput]:
     """Scatter the final segment registers back to the arena (one write per
     touched slot — the window's net effect) and un-sort the responses to
-    arrival order.  Shared by the XLA and Pallas paths."""
+    arrival order.  Shared by the XLA and Pallas paths.
+
+    commit_mask keeps the scatter one-write-per-SLOT: when eviction recycled
+    a slot mid-window the slot has several virtual segments, and only the
+    last tenant's final register may land in the arena (duplicate scatter
+    indices have undefined order in XLA)."""
     C = state.limit.shape[0]
-    wslot = jnp.where(prep.seg_start & prep.s_valid, prep.s_slot,
-                      jnp.int32(C))
+    wslot = jnp.where(prep.commit_mask, prep.s_slot, jnp.int32(C))
     new_state = BucketState(
         limit=state.limit.at[wslot].set(fin.limit, mode="drop"),
         duration=state.duration.at[wslot].set(fin.duration, mode="drop"),
@@ -454,7 +482,7 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
     prep = window_prep(state, batch, now)
     (order, s_slot, s_valid, s_hits, s_limit, s_duration, s_algo, s_init,
      seg_start, seg_start_idx, pos, seg_len, cur, fresh_seg, h0, l0, d0,
-     a0, seg_uniform, max_pos) = prep
+     a0, seg_uniform, max_pos, _commit_mask) = prep
     cur_fresh = s_init | (cur.expire < now)
 
     st = _Reg(*jax.tree.map(lambda a: a[seg_start_idx], cur))
@@ -471,12 +499,11 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
         active = (pos == p) & s_valid & ~seg_uniform
         reg = jax.tree.map(lambda a: a[seg_start_idx], cur)
         reg = _Reg(*reg)
-        # fresh: segment-level miss (expired/new at window start), an
-        # algorithm switch against the live register, or THIS lane having
-        # re-allocated the slot (capacity eviction can recycle a slot to a
-        # different key mid-window — its first lane must re-init, not
-        # inherit the previous tenant's register).
-        fresh = cur_fresh[seg_start_idx] | (s_algo != reg.algo) | s_init
+        # fresh: segment-level miss (expired/new/init at window start — an
+        # is_init lane always starts its own virtual segment, so its flag
+        # is carried by cur_fresh until its round clears it) or an
+        # algorithm switch against the live register.
+        fresh = cur_fresh[seg_start_idx] | (s_algo != reg.algo)
         new_reg, resp = transition(reg, s_hits, s_limit, s_duration, s_algo, now, fresh)
         # One active lane per segment → scatter back is collision-free.
         widx = jnp.where(active, seg_start_idx, jnp.int32(B))

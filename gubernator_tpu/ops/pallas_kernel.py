@@ -155,7 +155,10 @@ def _window_math_kernel(now_ref, maxpos_ref,
         p, lim, dur, rem, ts, exp, alg, fr, ost, oli, ore, ors = carry
         r = _Reg(limit=lim, duration=dur, remaining=rem, tstamp=ts,
                  expire=exp, algo=alg)
-        fresh = fr | (s_algo[:] != r.algo) | s_init[:]
+        # is_init lanes start their own virtual segment, so their
+        # freshness is carried by fr (fresh_seg) until their round clears
+        # it — no per-lane s_init term needed
+        fresh = fr | (s_algo[:] != r.algo)
         new_r, resp = kernel.transition(
             r, s_hits[:], s_limit[:], s_duration[:], s_algo[:], now, fresh)
         active = (p_arr == p) & valid & ~uniform
@@ -219,7 +222,7 @@ def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
     prep = kernel.window_prep(state, batch, now)
     (_, _, s_valid, s_hits, s_limit, s_duration, s_algo, s_init,
      _, seg_start_idx, pos, seg_len, cur, fresh_seg, h0, l0, d0, a0,
-     seg_uniform, max_pos) = prep
+     seg_uniform, max_pos, _commit_mask) = prep
 
     # under shard_map with check_vma the window arrays vary over the shard
     # axis; mirror the input's vma on the outputs.  The engine disables

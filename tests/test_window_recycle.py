@@ -1,0 +1,155 @@
+"""Mid-window slot recycling (virtual segments).
+
+Capacity eviction can hand a slot to a new key inside one window: the
+window then carries [old-tenant lanes][is_init lane + new-tenant lanes]
+for ONE slot.  window_prep splits segments at is_init lanes so each
+tenant's run stays eligible for the closed form — a recycled Zipf head
+key must not degenerate into a lane-by-lane replay of thousands of
+rounds (round-4 finding: such replays took ~200ms/window on the real
+chip and could crash the runtime).
+
+The sequential oracle here is window_step itself on chained SINGLE-lane
+windows — with one lane there is exactly one segment of length one, a
+path pinned by the branch-table tests in test_kernel_token/leaky.
+"""
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.ops import kernel
+
+T0 = 1_700_000_000_000
+
+
+def _batch(slots, hits, limits, durations, algos, inits):
+    n = len(slots)
+    return kernel.WindowBatch(
+        slot=np.asarray(slots, np.int32),
+        hits=np.asarray(hits, np.int64),
+        limit=np.asarray(limits, np.int64),
+        duration=np.asarray(durations, np.int64),
+        algo=np.asarray(algos, np.int32),
+        is_init=np.asarray(inits, bool),
+    )
+
+
+def _sequential(state, batch, now):
+    """Chain B single-lane windows — the mutex-serialized semantics."""
+    outs = []
+    for i in range(batch.slot.shape[0]):
+        one = kernel.WindowBatch(*[np.asarray(a)[i:i + 1] for a in batch])
+        state, out = kernel.window_step(state, one, now)
+        outs.append(out)
+    fused = kernel.WindowOutput(*[
+        np.concatenate([np.asarray(getattr(o, f)) for o in outs])
+        for f in kernel.WindowOutput._fields])
+    return state, fused
+
+
+def _assert_same(state_a, out_a, state_b, out_b):
+    for f in kernel.WindowOutput._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(out_a, f)), np.asarray(getattr(out_b, f)), f)
+    for f in kernel.BucketState._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(state_a, f)), np.asarray(getattr(state_b, f)),
+            f)
+
+
+CASES = {
+    # old tenant consumes, then eviction recycles slot 5 to a new tenant
+    # with a different config; both runs are uniform
+    "recycle_uniform": dict(
+        slots=[5] * 6 + [5] + [5] * 3,
+        hits=[1] * 6 + [1] + [1] * 3,
+        limits=[10] * 6 + [4] * 4,
+        durations=[60_000] * 6 + [30_000] * 4,
+        algos=[0] * 10,
+        inits=[False] * 6 + [True] + [False] * 3),
+    # double recycling: three tenants of slot 2 in one window
+    "recycle_twice": dict(
+        slots=[2, 2, 2, 2, 2, 2],
+        hits=[1, 1, 2, 1, 1, 1],
+        limits=[3, 3, 8, 8, 2, 2],
+        durations=[60_000] * 6,
+        algos=[0, 0, 1, 1, 0, 0],
+        inits=[False, False, True, False, True, False]),
+    # every lane init (the synthetic shape that crashed the worker):
+    # each duplicate is its own virtual segment
+    "all_init_duplicates": dict(
+        slots=[7, 7, 7, 7, 3, 3],
+        hits=[1] * 6,
+        limits=[5] * 6,
+        durations=[60_000] * 6,
+        algos=[0] * 6,
+        inits=[True] * 6),
+    # recycled run where the NEW tenant's lanes are irregular (mixed hits)
+    # -> replay, but only within the short virtual segment
+    "recycle_irregular_tail": dict(
+        slots=[9] * 4 + [9] * 4,
+        hits=[1, 1, 1, 1, 2, 0, 3, 1],
+        limits=[6] * 4 + [7] * 4,
+        durations=[60_000] * 8,
+        algos=[1] * 4 + [0] * 4,
+        inits=[False] * 4 + [True, False, False, False]),
+    # interleaved with other slots + padding lanes
+    "recycle_mixed_window": dict(
+        slots=[5, 1, 5, 5, -1, 1, 5, -1],
+        hits=[1, 1, 1, 1, 0, 2, 1, 0],
+        limits=[10, 3, 10, 8, 1, 3, 8, 1],
+        durations=[60_000] * 8,
+        algos=[0, 1, 0, 0, 0, 1, 0, 0],
+        inits=[False, False, False, True, False, False, False, False]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_recycle_matches_sequential(name):
+    spec = CASES[name]
+    batch = _batch(**spec)
+    # pre-populate old tenants so non-init first lanes read live state
+    state0 = kernel.BucketState.zeros(16)
+    warm = kernel.WindowBatch(
+        slot=np.asarray([5, 2, 9, 1], np.int32),
+        hits=np.asarray([1, 1, 1, 1], np.int64),
+        limit=np.asarray([10, 3, 6, 3], np.int64),
+        duration=np.asarray([60_000] * 4, np.int64),
+        algo=np.asarray([0, 0, 1, 1], np.int32),
+        is_init=np.ones(4, bool),
+    )
+    state0, _ = kernel.window_step(state0, warm, T0 - 1000)
+
+    state_w, out_w = kernel.window_step(state0, batch, T0)
+    state_s, out_s = _sequential(state0, batch, T0)
+    _assert_same(state_w, out_w, state_s, out_s)
+
+
+def test_recycled_uniform_runs_skip_replay():
+    """A recycled slot whose runs are both uniform must need NO replay
+    rounds (max_pos == -1) — the perf property the virtual split exists
+    for."""
+    spec = CASES["recycle_uniform"]
+    batch = _batch(**spec)
+    state = kernel.BucketState.zeros(16)
+    prep = kernel.window_prep(state, batch, np.int64(T0))
+    assert int(prep.max_pos) == -1
+    # one commit per touched physical slot
+    s = np.asarray(prep.s_slot)[np.asarray(prep.commit_mask)]
+    assert sorted(s.tolist()) == [5]
+
+
+def test_commit_mask_one_write_per_slot():
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        n = 32
+        slots = rng.integers(-1, 6, n).astype(np.int32)
+        inits = rng.random(n) < 0.4
+        batch = _batch(slots, np.ones(n), np.full(n, 5),
+                       np.full(n, 60_000), np.zeros(n), inits)
+        state = kernel.BucketState.zeros(8)
+        prep = kernel.window_prep(state, batch, np.int64(T0))
+        mask = np.asarray(prep.commit_mask)
+        committed = np.asarray(prep.s_slot)[mask]
+        assert len(committed) == len(set(committed.tolist()))
+        assert set(committed.tolist()) == set(
+            s for s in slots.tolist() if s >= 0)
