@@ -150,8 +150,15 @@ def acquire_backend(attempts=5, probe_timeout=75.0):
 
 
 def bench_device(kernel, jax, jnp, mesh, capacity, lanes, iters):
-    """Saturation: K pre-packed windows per dispatch, resident inputs,
-    un-fetched outputs (the kernel ceiling the host path chases)."""
+    """Saturation: K pre-packed windows per dispatch, resident inputs.
+
+    HONESTY NOTE (round-4 finding): on the tunneled axon runtime
+    `jax.block_until_ready` returns at enqueue — it does NOT wait for
+    device execution — so loop-and-block timing measures the enqueue
+    rate, not throughput (rounds 1-3 reported 1.1-1.6B/s that way; the
+    fetch-synced truth is ~3 orders lower).  Every measurement here
+    CHAINS dispatches through the donated state and ends with a real
+    device_get, so the wall time provably contains the device work."""
     import numpy as np
     from gubernator_tpu.core.engine import RateLimitEngine
 
@@ -195,20 +202,26 @@ def bench_device(kernel, jax, jnp, mesh, capacity, lanes, iters):
                                 upd, ups, nows, compact_safe=True,
                                 n_decisions=K * lanes)
 
+    out = None
     for i in range(3):  # warmup: compile + arena fill
         out = dispatch(i, now + i * K)
-    jax.block_until_ready(out)
+    np.asarray(out)  # REAL sync (fetch), not block_until_ready
 
     t0 = time.perf_counter()
     for i in range(iters):
         out = dispatch(i, now + (3 + i) * K)
-        jax.block_until_ready(out)
+    np.asarray(out)  # chained by donated state: fetch waits for ALL
     total = time.perf_counter() - t0
     per_sec = iters * K * lanes / total
-    log(f"# device tier: {iters} x {K} windows x {lanes} lanes "
-        f"-> {per_sec:,.0f} decisions/s; capacity={capacity}")
+    log(f"# device tier (fetch-synced): {iters} x {K} windows x {lanes} "
+        f"lanes -> {per_sec:,.0f} decisions/s; capacity={capacity}")
 
-    # single-window dispatch latency (low-load serving path)
+    # single-window latency: CH chained single dispatches, one final fetch;
+    # the separately-measured fetch RTT (median of trivial-op fetches of the
+    # same output shape) is subtracted before amortizing.  LIMITATION: each
+    # sample is a chain MEAN — per-window tails inside a chain are averaged
+    # ~CH-fold (per-window fetches would measure the tunnel RTT instead),
+    # so the reported "p99" is the WORST CHAIN MEAN, a damped tail signal.
     sb = jax.device_put(kernel.WindowBatch(*[a[:1] for a in pack_window()]))
     sg = jax.device_put(gbatch)
     sa = jax.device_put(gacc)
@@ -217,21 +230,28 @@ def bench_device(kernel, jax, jnp, mesh, capacity, lanes, iters):
         eng.state, sout, eng.gstate, eng.gcfg = eng._step_fn(
             eng.state, eng.gstate, eng.gcfg, sb, sg, sa, upd, ups,
             jnp.int64(now + 10_000 + i))
-    jax.block_until_ready(sout)
+    np.asarray(sout)
+    rtts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(jnp.asarray(sout) + 0)  # trivial op + fetch ≈ pure RTT
+        rtts.append(time.perf_counter() - t0)
+    rtt = float(np.median(rtts))
     slat = []
-    for i in range(50):
+    CH = 10
+    for rep in range(5):
         w0 = time.perf_counter()
-        eng.state, sout, eng.gstate, eng.gcfg = eng._step_fn(
-            eng.state, eng.gstate, eng.gcfg, sb, sg, sa, upd, ups,
-            jnp.int64(now + 20_000 + i))
-        jax.block_until_ready(sout)
-        slat.append(time.perf_counter() - w0)
+        for i in range(CH):
+            eng.state, sout, eng.gstate, eng.gcfg = eng._step_fn(
+                eng.state, eng.gstate, eng.gcfg, sb, sg, sa, upd, ups,
+                jnp.int64(now + 20_000 + rep * CH + i))
+        np.asarray(sout)
+        slat.append(max(time.perf_counter() - w0 - rtt, 0.0) / CH)
     slat_ms = np.array(slat) * 1000.0
-    log(f"# single window ({lanes} lanes): "
-        f"p50={np.percentile(slat_ms, 50):.3f}ms "
-        f"p99={np.percentile(slat_ms, 99):.3f}ms")
-    return per_sec, float(np.percentile(slat_ms, 50)), float(
-        np.percentile(slat_ms, 99))
+    p50, worst = float(np.percentile(slat_ms, 50)), float(np.max(slat_ms))
+    log(f"# single window ({lanes} lanes, chained, rtt {rtt * 1e3:.1f}ms "
+        f"subtracted): chain-mean p50={p50:.3f}ms worst={worst:.3f}ms")
+    return per_sec, p50, worst
 
 
 def _zipf_payloads(pb, n_payloads, items, keyspace, name):
@@ -416,9 +436,8 @@ def bench_bigkeys(mesh, on_cpu, seconds=5.0):
             packed, np.full(1, now + i, np.int64), n_windows=1)
         if fetch:
             np.asarray(words)
-        else:
-            jax.block_until_ready(words)
         native.commit()
+        return words
 
     for i in range(3):  # compile + warm
         one_window(i)
@@ -434,19 +453,37 @@ def bench_bigkeys(mesh, on_cpu, seconds=5.0):
     lat_ms = np.array(lat) * 1e3
     host_p99 = float(np.percentile(lat_ms, 99))
 
-    # device-only window latency at this arena size (no host fetch)
+    # device window time at this arena size: chained dispatches (donated
+    # state serializes them on-device), ONE final fetch with the measured
+    # fetch RTT subtracted — block_until_ready is an enqueue no-op on this
+    # runtime, so per-dispatch blocking would under-report (round-4
+    # finding).  Samples are chain means: per-window tails are damped
+    # ~CH-fold; the "p99" key carries the WORST chain mean.
+    last = one_window(9_999, fetch=True)
+    rtts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(jax.numpy.asarray(last) + 0)
+        rtts.append(time.perf_counter() - t0)
+    rtt = float(np.median(rtts))
     dlat = []
-    for i in range(30):
+    CH = 5
+    for rep in range(6):
         w0 = time.perf_counter()
-        one_window(10_000 + i, fetch=False)
-        dlat.append(time.perf_counter() - w0)
+        words = None
+        for i in range(CH):
+            words = one_window(10_000 + rep * CH + i, fetch=False)
+        np.asarray(words)
+        dlat.append(max(time.perf_counter() - w0 - rtt, 0.0) / CH)
     dlat_ms = np.array(dlat) * 1e3
     out = {
         "bigkey_keys": int(native.size),
         "bigkey_decisions_per_sec": round(per_sec, 1),
         "bigkey_host_p99_ms": round(host_p99, 3),
         "bigkey_window_p50_ms": round(float(np.percentile(dlat_ms, 50)), 3),
-        "bigkey_window_p99_ms": round(float(np.percentile(dlat_ms, 99)), 3),
+        # worst CHAIN MEAN, not a true per-window p99 (see comment above)
+        "bigkey_window_p99_ms": round(float(np.max(dlat_ms)), 3),
+        "window_timing_method": "chained_mean_rtt_subtracted",
     }
     log(f"# bigkey tier: {native.size:,} keys, {per_sec:,.0f} decisions/s, "
         f"host p99 {host_p99:.1f}ms, device window "

@@ -1,0 +1,81 @@
+"""Probe: pipeline_dispatch latency vs arena capacity on the real chip.
+
+The round-4 bench measured 209ms device window p50 at a 2^27-slot arena vs
+0.151ms at 2^20 — this isolates whether that scales with capacity (device
+compute / missing aliasing) or is a transfer/host artifact.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("GUBER_JAX_CACHE", "/root/repo/.jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+from gubernator_tpu.core.engine import RateLimitEngine
+from gubernator_tpu.parallel.mesh import make_mesh
+
+devs = jax.devices()
+print(f"# backend: {devs[0].platform}", file=sys.stderr, flush=True)
+mesh = make_mesh(devs[:1])
+lanes = 32768
+now = 1_700_000_000_000
+rng = np.random.default_rng(5)
+
+for log2cap in (20, 24, 27):
+    cap = 1 << log2cap
+    eng = RateLimitEngine(mesh=mesh, capacity_per_shard=cap,
+                          batch_per_shard=lanes, global_capacity=64,
+                          global_batch_per_shard=8, max_global_updates=8)
+    # compact request stack straight from numpy (slot+1 in w0 bits 0..31,
+    # hits=1 at bits 34..61 -> w0 |= 1<<34; w1 = limit | duration<<32)
+    slots = ((rng.zipf(1.1, lanes) - 1) % cap).astype(np.int64)
+    w0 = (slots + 1) | (1 << 32) | (1 << 34)
+    w1 = np.int64(1_000_000) | (np.int64(600_000) << 32)
+    packed = np.zeros((1, 1, lanes, 2), np.int64)
+    packed[0, 0, :, 0] = w0
+    packed[0, 0, :, 1] = w1
+    nows = np.full(1, now, np.int64)
+
+    for i in range(3):
+        w, l, m = eng.pipeline_dispatch(packed, nows + i, n_windows=1)
+    jax.block_until_ready(w)
+
+    # (a) dispatch + block (no fetch)
+    ts = []
+    for i in range(15):
+        t0 = time.perf_counter()
+        w, l, m = eng.pipeline_dispatch(packed, nows + 10 + i, n_windows=1)
+        jax.block_until_ready(w)
+        ts.append(time.perf_counter() - t0)
+    disp = np.percentile(np.array(ts) * 1e3, 50)
+
+    # (b) upload cost alone: device_put the packed stack
+    ts = []
+    for i in range(15):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.device_put(packed))
+        ts.append(time.perf_counter() - t0)
+    up = np.percentile(np.array(ts) * 1e3, 50)
+
+    # (c) resident input: dispatch with pre-uploaded packed
+    dpacked = jax.device_put(packed)
+    jax.block_until_ready(dpacked)
+    ts = []
+    for i in range(15):
+        t0 = time.perf_counter()
+        w, l, m = eng.pipeline_dispatch(dpacked, nows + 40 + i, n_windows=1)
+        jax.block_until_ready(w)
+        ts.append(time.perf_counter() - t0)
+    res = np.percentile(np.array(ts) * 1e3, 50)
+
+    print(f"cap=2^{log2cap}: dispatch+block p50={disp:.2f}ms  "
+          f"upload-only p50={up:.2f}ms  resident-input p50={res:.2f}ms",
+          flush=True)
+    del eng, dpacked, w, l, m

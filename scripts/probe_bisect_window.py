@@ -1,0 +1,108 @@
+"""Bisect the serving window executable: which stage costs the 48ms?
+
+Variants build up the real pipeline body (decode -> prep -> closed form
+-> replay -> commit -> encode) and each is timed by K-slope (4 vs 12
+python-unrolled reps inside one jit, state chained through), so the
+~70ms fetch RTT and dispatch overheads cancel.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+from gubernator_tpu.ops import kernel
+from gubernator_tpu.ops.kernel import BucketState, _Reg, WindowOutput
+
+B = 32768
+C = 1 << 20
+now0 = 1_700_000_000_000
+rng = np.random.default_rng(5)
+print(f"# backend: {jax.devices()[0].platform}", file=sys.stderr, flush=True)
+
+slots = ((rng.zipf(1.1, B) - 1) % C).astype(np.int64)
+packed = np.zeros((B, 2), np.int64)
+packed[:, 0] = (slots + 1) | (1 << 34)
+packed[:, 1] = np.int64(1_000_000) | (np.int64(600_000) << 32)
+dpacked = jax.device_put(packed)
+state0 = BucketState.zeros(C)
+
+
+def v_decode(state, pk, now):
+    bt = kernel.decode_batch(pk)
+    s = (jnp.sum(bt.slot) + jnp.sum(bt.hits) + jnp.sum(bt.limit)
+         + jnp.sum(bt.duration))
+    return state, s
+
+
+def v_prep(state, pk, now):
+    bt = kernel.decode_batch(pk)
+    prep = kernel.window_prep(state, bt, now)
+    s = (jnp.sum(prep.pos) + jnp.sum(prep.seg_len) + jnp.sum(prep.cur.limit)
+         + prep.max_pos + jnp.sum(prep.commit_mask) + jnp.sum(prep.h0))
+    return state, s
+
+
+def v_closed(state, pk, now):
+    bt = kernel.decode_batch(pk)
+    prep = kernel.window_prep(state, bt, now)
+    st = _Reg(*jax.tree.map(lambda a: a[prep.seg_start_idx], prep.cur))
+    fresh0 = (prep.fresh_seg | (prep.a0 != st.algo))
+    ff_reg, ff_out = kernel.uniform_closed_form(
+        st, fresh0, prep.h0, prep.l0, prep.d0, prep.a0, prep.pos,
+        prep.seg_len, now)
+    s = jnp.sum(ff_out.remaining) + jnp.sum(ff_reg.remaining)
+    return state, s
+
+
+def v_full_step(state, pk, now):
+    bt = kernel.decode_batch(pk)
+    state, out = kernel.window_step(state, bt, now)
+    return state, jnp.sum(out.remaining)
+
+
+def v_pipeline(state, pk, now):
+    bt = kernel.decode_batch(pk)
+    state, out = kernel.window_step(state, bt, now)
+    word = kernel.encode_output_word(out, now)
+    mism = jnp.any((out.limit != bt.limit) & (bt.slot >= 0))
+    return state, jnp.sum(word) + mism.astype(jnp.int64)
+
+
+def slope(v):
+    fns = {}
+    for k in (4, 12):
+        def go(state, pk, _k=k):
+            acc = jnp.int64(0)
+            for i in range(_k):
+                state, s = v(state, pk, now0 + i + acc % 3)
+                acc = acc + s
+            return acc
+        fns[k] = jax.jit(go, donate_argnums=(0,))
+
+    def t(k, reps=5):
+        np.asarray(fns[k](BucketState.zeros(C), dpacked))
+        ts = []
+        for _ in range(reps):
+            st = BucketState.zeros(C)
+            jax.block_until_ready(st.limit)
+            t0 = time.perf_counter()
+            np.asarray(fns[k](st, dpacked))
+            ts.append(time.perf_counter() - t0)
+        return float(np.percentile(np.array(ts) * 1e3, 50))
+    return (t(12) - t(4)) / 8
+
+
+for name, v in [("decode", v_decode), ("decode+prep", v_prep),
+                ("decode+prep+closed", v_closed),
+                ("full window_step", v_full_step),
+                ("pipeline body", v_pipeline)]:
+    print(f"{name:20s} {slope(v):8.2f}ms/window", flush=True)

@@ -1,0 +1,64 @@
+"""Honest device-side window time at large arenas: K back-to-back
+pipeline dispatches (serialized on-device by the donated state chain),
+ONE final fetch; device window time ~= (total - fetch_rtt) / K.
+
+The round-4 bench's 'bigkey device window p50 209ms' measured tunnel
+synchronization, not device compute — this separates them.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+from gubernator_tpu.core.engine import RateLimitEngine
+from gubernator_tpu.parallel.mesh import make_mesh
+
+devs = jax.devices()
+print(f"# backend: {devs[0].platform}", file=sys.stderr, flush=True)
+mesh = make_mesh(devs[:1])
+lanes = 32768
+now = 1_700_000_000_000
+rng = np.random.default_rng(5)
+K = 10
+
+for log2cap in (20, 27):
+    cap = 1 << log2cap
+    eng = RateLimitEngine(mesh=mesh, capacity_per_shard=cap,
+                          batch_per_shard=lanes, global_capacity=64,
+                          global_batch_per_shard=8, max_global_updates=8)
+    slots = ((rng.zipf(1.1, lanes) - 1) % cap).astype(np.int64)
+    w0 = (slots + 1) | (1 << 32) | (1 << 34)
+    w1 = np.int64(1_000_000) | (np.int64(600_000) << 32)
+    packed = np.zeros((1, 1, lanes, 2), np.int64)
+    packed[0, 0, :, 0] = w0
+    packed[0, 0, :, 1] = w1
+    nows = np.full(1, now, np.int64)
+    dpacked = jax.device_put(packed)
+
+    w, _, _ = eng.pipeline_dispatch(dpacked, nows, n_windows=1)
+    np.asarray(w)  # compile + full sync
+
+    # fetch RTT floor: dispatch once, fetch
+    t0 = time.perf_counter()
+    w, _, _ = eng.pipeline_dispatch(dpacked, nows + 1, n_windows=1)
+    np.asarray(w)
+    rtt = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for i in range(K):
+        w, _, _ = eng.pipeline_dispatch(dpacked, nows + 2 + i, n_windows=1)
+    np.asarray(w)
+    total = time.perf_counter() - t0
+    per = (total - rtt) / K * 1e3
+    print(f"cap=2^{log2cap}: {K} chained dispatches in {total*1e3:.1f}ms "
+          f"(1-dispatch+fetch rtt {rtt*1e3:.1f}ms) -> "
+          f"device window ~{per:.3f}ms", flush=True)
+    del eng, w, dpacked

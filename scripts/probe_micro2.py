@@ -1,0 +1,65 @@
+"""Micro 2: separate scan overhead, int division, sort, gather costs.
+All bodies consume FULL arrays into the carry (sum) so XLA cannot DCE."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+B = 32768
+K = 32
+rng = np.random.default_rng(5)
+print(f"# backend: {jax.devices()[0].platform}", file=sys.stderr, flush=True)
+
+
+def timed(fn, *args, reps=7):
+    out = fn(*args)
+    np.asarray(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.percentile(np.array(ts) * 1e3, 50)) / K
+
+
+def scan_of(body, carry_dtype=jnp.int64):
+    @jax.jit
+    def go(*arrays):
+        def step(c, _):
+            return body(c, arrays), None
+        c, _ = lax.scan(step, jnp.asarray(0, carry_dtype), None, length=K)
+        return c
+    return go
+
+
+a64 = jnp.asarray(rng.integers(1, 1 << 40, B, dtype=np.int64))
+b64 = jnp.asarray(rng.integers(1, 1 << 20, B, dtype=np.int64))
+a32 = jnp.asarray(rng.integers(1, 1 << 20, B, dtype=np.int32))
+b32 = jnp.asarray(rng.integers(1, 1 << 10, B, dtype=np.int32))
+
+empty = scan_of(lambda c, ar: c + 1)
+mul64 = scan_of(lambda c, ar: c + jnp.sum((ar[0] + c) * ar[1] * 3 + 7))
+div64 = scan_of(lambda c, ar: c + jnp.sum((ar[0] + c) // ar[1]))
+mod64 = scan_of(lambda c, ar: c + jnp.sum((ar[0] + c) % ar[1]))
+div32 = scan_of(lambda c, ar: c + jnp.sum((ar[0] + c) // ar[1]),
+                jnp.int32)
+sortf = scan_of(lambda c, ar: c + jnp.sum(jnp.argsort(ar[0] ^ c)),
+                jnp.int32)
+gath64 = scan_of(lambda c, ar: c + jnp.sum(ar[0][(ar[1] + c) % B]))
+
+print(f"empty scan      {timed(empty, a64):8.3f}ms/rep", flush=True)
+print(f"mul i64         {timed(mul64, a64, b64):8.3f}ms/rep", flush=True)
+print(f"div i64         {timed(div64, a64, b64):8.3f}ms/rep", flush=True)
+print(f"mod i64         {timed(mod64, a64, b64):8.3f}ms/rep", flush=True)
+print(f"div i32         {timed(div32, a32, b32):8.3f}ms/rep", flush=True)
+print(f"argsort i32     {timed(sortf, a32):8.3f}ms/rep", flush=True)
+print(f"gather i64      {timed(gath64, a64, b64):8.3f}ms/rep", flush=True)

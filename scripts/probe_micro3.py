@@ -1,0 +1,109 @@
+"""Micro 3: is the 2.2ms/iter a control-flow dispatch cost (goes away
+when unrolled)?  And what do XLA scatters really cost on this runtime?"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+B = 32768
+K = 32
+rng = np.random.default_rng(5)
+print(f"# backend: {jax.devices()[0].platform}", file=sys.stderr, flush=True)
+
+
+def timed(fn, *args, reps=7, per=K):
+    out = fn(*args)
+    np.asarray(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.percentile(np.array(ts) * 1e3, 50)) / per
+
+
+a64 = jnp.asarray(rng.integers(1, 1 << 40, B, dtype=np.int64))
+idx = jnp.asarray(rng.integers(0, 1 << 20, B, dtype=np.int32))
+perm = jnp.asarray(rng.permutation(B).astype(np.int32))
+arena = jnp.asarray(rng.integers(1, 1 << 40, 1 << 20, dtype=np.int64))
+
+
+@jax.jit
+def scan_unrolled(a):
+    c = jnp.int64(0)
+    for _ in range(K):  # straight-line HLO
+        c = c + jnp.sum(a + c)
+    return c
+
+
+@jax.jit
+def scan_rolled(a):
+    def step(c, _):
+        return c + jnp.sum(a + c), None
+    c, _ = lax.scan(step, jnp.int64(0), None, length=K)
+    return c
+
+
+@jax.jit
+def scan_unroll_arg(a):
+    def step(c, _):
+        return c + jnp.sum(a + c), None
+    c, _ = lax.scan(step, jnp.int64(0), None, length=K, unroll=K)
+    return c
+
+
+@jax.jit
+def whileloop(a):
+    def cond(c):
+        return c[0] < K
+
+    def step(c):
+        i, acc = c
+        return (i + 1, acc + jnp.sum(a + acc))
+    return lax.while_loop(cond, step, (jnp.int32(0), jnp.int64(0)))[1]
+
+
+@jax.jit
+def scatter_arena(ar, i, v):
+    c = jnp.int64(0)
+    for t in range(8):  # 8 scatters, straight-line
+        ar = ar.at[(i + t) % (1 << 20)].set(v + c, mode="drop")
+        c = c + ar[0]
+    return c
+
+
+@jax.jit
+def scatter_unsort(v, p):
+    c = jnp.int64(0)
+    for t in range(8):
+        o = jnp.zeros_like(v).at[p].set(v + c)
+        c = c + o[0]
+    return c
+
+
+@jax.jit
+def gather_unsort(v, p):
+    inv = jnp.argsort(p)
+    c = jnp.int64(0)
+    for t in range(8):
+        o = (v + c)[inv]
+        c = c + o[0]
+    return c
+
+
+print(f"unrolled python loop {timed(scan_unrolled, a64):8.3f}ms/it", flush=True)
+print(f"lax.scan             {timed(scan_rolled, a64):8.3f}ms/it", flush=True)
+print(f"lax.scan unroll=K    {timed(scan_unroll_arg, a64):8.3f}ms/it", flush=True)
+print(f"lax.while_loop       {timed(whileloop, a64):8.3f}ms/it", flush=True)
+print(f"scatter 32k->2^20    {timed(scatter_arena, arena, idx, a64, per=8):8.3f}ms/op", flush=True)
+print(f"scatter-unsort [B]   {timed(scatter_unsort, a64, perm, per=8):8.3f}ms/op", flush=True)
+print(f"gather-unsort  [B]   {timed(gather_unsort, a64, perm, per=8):8.3f}ms/op", flush=True)

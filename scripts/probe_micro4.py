@@ -1,0 +1,73 @@
+"""Micro 4: slope-differenced op costs (immune to the ~70ms fetch RTT):
+time K=4 vs K=36 internal reps, slope = (t36 - t4) / 32."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+B = 32768
+rng = np.random.default_rng(5)
+print(f"# backend: {jax.devices()[0].platform}", file=sys.stderr, flush=True)
+
+a64 = jnp.asarray(rng.integers(1, 1 << 40, B, dtype=np.int64))
+i32 = jnp.asarray(rng.integers(0, B, B, dtype=np.int32))
+idx20 = jnp.asarray(rng.integers(0, 1 << 20, B, dtype=np.int32))
+arena = jnp.asarray(rng.integers(1, 1 << 40, 1 << 20, dtype=np.int64))
+bools = jnp.asarray(rng.random(B) < 0.1)
+
+
+def slope(body, *args):
+    fns = {}
+    for k in (4, 36):
+        def go(c0, *ar, _k=k):
+            c = c0
+            for _ in range(_k):
+                c = body(c, *ar)
+            return c
+        fns[k] = jax.jit(go)
+        np.asarray(fns[k](jnp.int64(0), *args))  # compile
+
+    def t(k, reps=5):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(fns[k](jnp.int64(0), *args))
+            ts.append(time.perf_counter() - t0)
+        return float(np.percentile(np.array(ts) * 1e3, 50))
+    return (t(36) - t(4)) / 32
+
+
+tests = {
+    "noop (c+1)":       (lambda c: c + 1,),
+    "sum i64":          (lambda c, a: c + jnp.sum(a + c), a64),
+    "cummax i32":       (lambda c, a: c + lax.cummax(a + c.astype(jnp.int32)
+                                                     )[B - 1], i32),
+    "cummin flip i32":  (lambda c, a: c + jnp.flip(lax.cummin(jnp.flip(
+        a + c.astype(jnp.int32))))[0], i32),
+    "assoc-scan max":   (lambda c, a: c + lax.associative_scan(
+        jnp.maximum, a + c.astype(jnp.int32))[B - 1], i32),
+    "argsort i32":      (lambda c, a: c + jnp.sum(jnp.argsort(
+        a ^ c.astype(jnp.int32))), i32),
+    "sort i64 payload": (lambda c, a, p: c + jnp.sum(
+        p[jnp.argsort(a ^ c.astype(jnp.int32))]), i32, a64),
+    "scatter 32k->2^20": (lambda c, ar, i, v: jnp.sum(
+        ar.at[(i + c.astype(jnp.int32)) % (1 << 20)].set(v, mode="drop")
+        [:8]) + c, arena, idx20, a64),
+    "gather 2^20->32k": (lambda c, ar, i: c + jnp.sum(
+        ar[(i + c.astype(jnp.int32)) % (1 << 20)]), arena, idx20),
+    "where+seg chain":  (lambda c, a: c + jnp.sum(jnp.where(
+        bools, a + c, a - c)), a64),
+}
+
+for name, spec in tests.items():
+    body, *args = spec
+    print(f"{name:18s} {slope(body, *args):8.3f}ms/op", flush=True)

@@ -1,0 +1,140 @@
+"""Honest per-window device cost, measured INSIDE one dispatch.
+
+pipeline_dispatch supports K stacked windows (lax.scan); timing K=1 vs
+K=9 with a real fetch after each isolates per-window device time from
+dispatch/RTT overhead: slope = (t_K9 - t_K1) / 8.
+
+Then micro-benchmarks of the suspected dominators, each K-repeated
+inside one jit with a data dependence so XLA cannot CSE them:
+  sort32    argsort of i32[B]
+  math64    the transition ladder on i64[B] lanes (int64 is EMULATED on
+            v5e — no native 64-bit vector ALU)
+  math32    the same ladder on i32 (what a 32-bit reformulation would pay)
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+from gubernator_tpu.core.engine import RateLimitEngine
+from gubernator_tpu.parallel.mesh import make_mesh
+
+B = 32768
+now0 = 1_700_000_000_000
+devs = jax.devices()
+print(f"# backend: {devs[0].platform}", file=sys.stderr, flush=True)
+mesh = make_mesh(devs[:1])
+rng = np.random.default_rng(5)
+
+
+def timed(fn, *args, reps=7):
+    outs = fn(*args)
+    np.asarray(jax.tree.leaves(outs)[0])  # compile + sync
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        outs = fn(*args)
+        np.asarray(jax.tree.leaves(outs)[0])
+        ts.append(time.perf_counter() - t0)
+    return float(np.percentile(np.array(ts) * 1e3, 50))
+
+
+# ---- true per-window cost via K-stack slope ----
+def stacked_time(k, cap):
+    eng = RateLimitEngine(mesh=mesh, capacity_per_shard=cap,
+                          batch_per_shard=B, global_capacity=64,
+                          global_batch_per_shard=8, max_global_updates=8)
+    slots = ((rng.zipf(1.1, (k, B)) - 1) % cap).astype(np.int64)
+    packed = np.zeros((k, 1, B, 2), np.int64)
+    packed[:, 0, :, 0] = (slots + 1) | (1 << 34)  # hits=1, no init
+    packed[:, 0, :, 1] = np.int64(1_000_000) | (np.int64(600_000) << 32)
+    nows = now0 + np.arange(k, dtype=np.int64)
+    dpacked = jax.device_put(packed)
+
+    def go(p, n):
+        w, l, m = eng.pipeline_dispatch(p, n, n_windows=k)
+        return w
+
+    ms = timed(go, dpacked, nows)
+    del eng
+    return ms
+
+
+for cap in (1 << 20, 1 << 24):
+    t1 = stacked_time(1, cap)
+    t9 = stacked_time(9, cap)
+    print(f"cap=2^{int(np.log2(cap))}: K=1 {t1:.2f}ms  K=9 {t9:.2f}ms  "
+          f"-> per-window {(t9 - t1) / 8:.2f}ms", flush=True)
+
+# ---- micro: sort / i64 math / i32 math ----
+K = 32
+keys = jnp.asarray(rng.integers(0, 1 << 20, B, dtype=np.int32))
+
+
+@jax.jit
+def sort_only(keys):
+    def body(c, _):
+        o = jnp.argsort(keys ^ c)
+        return (c + o[0]).astype(jnp.int32), o[0]
+    c, _ = lax.scan(body, jnp.int32(0), None, length=K)
+    return c
+
+
+@jax.jit
+def sortkv_only(keys):
+    # sort_key + argsort is how window_prep does it; also time carrying
+    # the payload through jnp.take (6 gathers)
+    payload = jnp.stack([keys.astype(jnp.int64)] * 6)
+
+    def body(c, _):
+        o = jnp.argsort(keys ^ c)
+        p = payload[:, o]
+        return (c + o[0] + p[0, 0].astype(jnp.int32)).astype(jnp.int32), p[0, 0]
+    c, _ = lax.scan(body, jnp.int32(0), None, length=K)
+    return c
+
+
+def math_ladder(dtype):
+    h = jnp.asarray(rng.integers(1, 5, B), dtype)
+    l = jnp.asarray(rng.integers(1, 1000, B), dtype)
+    d = jnp.asarray(rng.integers(1, 60000, B), dtype)
+    r = jnp.asarray(rng.integers(0, 1000, B), dtype)
+    ts = jnp.asarray(rng.integers(0, 1 << 30, B), dtype)
+
+    @jax.jit
+    def go(h, l, d, r, ts):
+        def body(c, _):
+            now = ts + c
+            rate = d // jnp.maximum(l, 1)
+            leak = jnp.where(rate > 0, (now - ts) // jnp.maximum(rate, 1), 0)
+            rem = jnp.minimum(r + leak, l)
+            over = h > rem
+            rem2 = jnp.where(over, rem, rem - h)
+            exp = now + d
+            reset = jnp.where(over, now + rate, exp)
+            out = jnp.where(h == 0, rem, rem2) + reset % 7
+            return c + out[0].astype(dtype), out[0]
+        c, _ = lax.scan(body, jnp.asarray(0, dtype), None, length=K)
+        return c
+    return go, (h, l, d, r, ts)
+
+
+s_ms = timed(sort_only, keys)
+skv_ms = timed(sortkv_only, keys)
+f64, a64 = math_ladder(jnp.int64)
+f32, a32 = math_ladder(jnp.int32)
+m64 = timed(f64, *a64)
+m32 = timed(f32, *a32)
+print(f"micro (per rep over K={K}): argsort {s_ms / K:.3f}ms  "
+      f"argsort+6 gathers {skv_ms / K:.3f}ms  "
+      f"i64 ladder {m64 / K:.3f}ms  i32 ladder {m32 / K:.3f}ms", flush=True)

@@ -1,0 +1,111 @@
+"""Break the bigkey 'device window' time into its real parts:
+zipf gen | C pack_stack (full router) | dispatch+block | device_get fetch.
+
+Run at 2^24 (fast prefill) — the device probe showed dispatch does not
+scale with capacity, so the question is which HOST piece produced the
+209ms p50 the round-4 bench attributed to the device window.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+from gubernator_tpu.core.engine import RateLimitEngine
+from gubernator_tpu.parallel.mesh import make_mesh
+
+devs = jax.devices()
+print(f"# backend: {devs[0].platform}", file=sys.stderr, flush=True)
+mesh = make_mesh(devs[:1])
+capacity = 1 << 24
+lanes = 32768
+now = 1_700_000_000_000
+
+eng = RateLimitEngine(mesh=mesh, capacity_per_shard=capacity,
+                      batch_per_shard=lanes, global_capacity=64,
+                      global_batch_per_shard=8, max_global_updates=8)
+native = eng.native
+assert native is not None
+
+# prefill the router to a FULL table (same as bench_bigkeys)
+t0 = time.perf_counter()
+chunk = 1 << 16
+ends = (np.arange(chunk, dtype=np.int64) + 1) * 8
+ones = np.ones(chunk, np.int64)
+lim = np.full(chunk, 1_000_000, np.int64)
+dur = np.full(chunk, 600_000, np.int64)
+alg = np.zeros(chunk, np.int32)
+o_slot = np.empty(chunk, np.int32)
+o_hits = np.empty(chunk, np.int64)
+o_lim = np.empty(chunk, np.int64)
+o_dur = np.empty(chunk, np.int64)
+o_alg = np.empty(chunk, np.int32)
+o_init = np.empty(chunk, np.uint8)
+o_shard = np.empty(chunk, np.int32)
+o_lane = np.empty(chunk, np.int32)
+for base in range(0, capacity, chunk):
+    keys = (base + np.arange(chunk, dtype=np.uint64)).view(np.uint8)
+    fill = np.zeros(1, np.int32)
+    native.pack(keys, ends, ones, lim, dur, alg, now, chunk,
+                o_slot, o_hits, o_lim, o_dur, o_alg, o_init,
+                o_shard, o_lane, fill)
+    native.commit()
+print(f"# prefilled {native.size:,} keys in {time.perf_counter()-t0:.1f}s",
+      flush=True)
+
+rng = np.random.default_rng(13)
+packed = np.zeros((1, 1, lanes, 2), np.int64)
+row = np.empty(lanes, np.int32)
+lane_arr = np.empty(lanes, np.int32)
+l_ends = (np.arange(lanes, dtype=np.int64) + 1) * 8
+l_ones = np.ones(lanes, np.int64)
+l_lim = np.full(lanes, 1_000_000, np.int64)
+l_dur = np.full(lanes, 600_000, np.int64)
+l_alg = np.zeros(lanes, np.int32)
+keyspace = capacity + capacity // 8
+
+T = {"zipf": [], "pack": [], "dispatch": [], "fetch": [], "commit": []}
+words = None
+for i in range(20):
+    t0 = time.perf_counter()
+    ids = ((rng.zipf(1.1, lanes) - 1) % keyspace).astype(np.uint64)
+    keys = ids.view(np.uint8)
+    t1 = time.perf_counter()
+    kcur = np.zeros(1, np.int32)
+    fills = np.zeros((1, 1), np.int32)
+    native.drain_begin()
+    step = 1024
+    for b in range(0, lanes, step):
+        rc = native.pack_stack(
+            keys[b * 8:(b + step) * 8], l_ends[:step],
+            l_ones[:step], l_lim[:step], l_dur[:step], l_alg[:step],
+            now + i, lanes, 1, packed, kcur, fills,
+            row[b:b + step], lane_arr[b:b + step])
+        assert rc == step, rc
+    t2 = time.perf_counter()
+    words, _, _ = eng.pipeline_dispatch(
+        packed, np.full(1, now + i, np.int64), n_windows=1)
+    jax.block_until_ready(words)
+    t3 = time.perf_counter()
+    host_words = np.asarray(words)
+    t4 = time.perf_counter()
+    native.commit()
+    t5 = time.perf_counter()
+    if i >= 3:
+        T["zipf"].append(t1 - t0)
+        T["pack"].append(t2 - t1)
+        T["dispatch"].append(t3 - t2)
+        T["fetch"].append(t4 - t3)
+        T["commit"].append(t5 - t4)
+
+for k, v in T.items():
+    a = np.array(v) * 1e3
+    print(f"{k:9s} p50={np.percentile(a, 50):8.2f}ms  "
+          f"p99={np.percentile(a, 99):8.2f}ms", flush=True)
