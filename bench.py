@@ -606,9 +606,12 @@ def bench_e2e(mesh, capacity, lanes, seconds=5.0, concurrency=32):
 
 def bench_pallas_probe(on_cpu):
     """Attempt ONE Pallas-lowered window on the real backend and record
-    whether Mosaic accepts the int64 kernel (PARITY known gap: unvalidated
-    while the tunnel was down).  Interpret mode on CPU == trivially true,
-    so only the TPU answer is informative."""
+    whether Mosaic accepts it.  Probes the compact32 (rebased int32)
+    kernel — Mosaic has no 64-bit vector types (round-4 probe:
+    "64-bit types are not supported"), so compact32 is the form the
+    engine's serving path actually uses on hardware under GUBER_PALLAS=1.
+    Interpret mode on CPU == trivially true; only the TPU answer is
+    informative."""
     try:
         import numpy as np
 
@@ -627,15 +630,14 @@ def bench_pallas_probe(on_cpu):
         t0 = time.perf_counter()
         new_state, out = window_step_pallas(state, batch,
                                             1_700_000_000_000,
-                                            interpret=on_cpu)
-        import jax
-        jax.block_until_ready(out.status)
+                                            interpret=on_cpu,
+                                            compact32=True)
+        got = np.asarray(out.remaining)  # real fetch, not block_until_ready
         # spot-check against the XLA path
         _, want = kernel.window_step(kernel.BucketState.zeros(1024), batch,
                                      1_700_000_000_000)
-        ok = bool((np.asarray(out.remaining) ==
-                   np.asarray(want.remaining)).all())
-        log(f"# pallas probe: {'ok' if ok else 'MISMATCH'} "
+        ok = bool((got == np.asarray(want.remaining)).all())
+        log(f"# pallas probe (compact32): {'ok' if ok else 'MISMATCH'} "
             f"({time.perf_counter() - t0:.1f}s incl. compile, "
             f"interpret={on_cpu})")
         return {"pallas_window_ok": ok}

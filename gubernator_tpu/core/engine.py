@@ -1342,16 +1342,33 @@ def _use_pallas() -> bool:
     return os.environ.get("GUBER_PALLAS") == "1"
 
 
-def _window_step_fn(mesh: Mesh):
+def _window_step_fn(mesh: Mesh, compact32: bool, pallas: bool):
     """kernel.window_step, or its Pallas lowering under GUBER_PALLAS=1
     (interpret mode when the MESH's devices are CPU — Mosaic is TPU-only,
-    and the process default backend may differ from the mesh platform)."""
-    if _use_pallas():
+    and the process default backend may differ from the mesh platform).
+
+    compact32 marks call sites whose lanes are guaranteed inside the
+    compact wire-format ranges (the pipeline drain): there the Pallas
+    kernel runs in rebased int32, which is the ONLY form Mosaic accepts
+    on real TPU (no 64-bit vector types).  Full-format call sites on a
+    TPU mesh keep the XLA path — an int64 Pallas kernel cannot lower.
+
+    `pallas` is REQUIRED and threaded from the compiled-builder cache
+    key so a jit object built under one GUBER_PALLAS setting cannot
+    trace under another (the builders cache per (mesh, pallas)); an
+    env-reading default here would reintroduce the trace-time read the
+    cache key exists to eliminate."""
+    if pallas:
         from functools import partial
 
         from gubernator_tpu.ops.pallas_kernel import window_step_pallas
-        return partial(window_step_pallas,
-                       interpret=_mesh_on_cpu(mesh))
+        on_cpu = _mesh_on_cpu(mesh)
+        if compact32:
+            return partial(window_step_pallas, interpret=on_cpu,
+                           compact32=True)
+        if on_cpu:
+            return partial(window_step_pallas, interpret=True)
+        return kernel.window_step
     return kernel.window_step
 
 
@@ -1397,7 +1414,7 @@ def _apply_control(gstate: BucketState, gcfg: GlobalConfig, upd, ups):
 
 
 def _global_window(gstate: BucketState, gcfg: GlobalConfig, gb: WindowBatch,
-                   gacc_row, now, mesh: Mesh):
+                   gacc_row, now, mesh: Mesh, pallas: bool):
     """One window of GLOBAL traffic: replica reads + the reconciliation psum.
 
     The whole GLOBAL dance — the reference's async hit send plus owner
@@ -1408,27 +1425,36 @@ def _global_window(gstate: BucketState, gcfg: GlobalConfig, gb: WindowBatch,
         jnp.zeros_like(gstate.remaining), gb._replace(hits=gacc_row)
     )
     summed = lax.psum(delta, SHARD_AXIS)
-    if _use_pallas():
+    # Pallas GLOBAL apply only in interpret mode (CPU meshes/tests): the
+    # kernel is int64 and Mosaic has no 64-bit vectors on real TPU, and
+    # unlike the serving window the GLOBAL arena is EXEMPT from the
+    # compact range caps (core/engine.py _compiled_step_compact note),
+    # so a rebased-i32 form would not be exact — XLA serves the TPU path.
+    if pallas and _mesh_on_cpu(mesh):
         from gubernator_tpu.ops.pallas_kernel import global_apply_pallas
         new_g = global_apply_pallas(
-            gstate, gcfg, summed, now, interpret=_mesh_on_cpu(mesh))
+            gstate, gcfg, summed, now, interpret=True)
     else:
         new_g = kernel.global_apply(gstate, gcfg, summed, now)
     return new_g, gout
 
 
-@lru_cache(maxsize=None)
 def _compiled_step(mesh: Mesh):
+    return _compiled_step_impl(mesh, _use_pallas())
+
+
+@lru_cache(maxsize=None)
+def _compiled_step_impl(mesh: Mesh, pallas: bool):
     def shard_fn(state, gstate, gcfg, batch, gbatch, gacc, upd, ups, now):
             # Block shapes inside shard_map: state [1, C]; batch/gbatch [1, B*];
             # gstate/gcfg [G] (replicated); upd/ups [K*] (replicated).
             st = BucketState(*jax.tree.map(lambda a: a[0], state))
             bt = WindowBatch(*jax.tree.map(lambda a: a[0], batch))
-            new_st, out = _window_step_fn(mesh)(st, bt, now)
+            new_st, out = _window_step_fn(mesh, compact32=False, pallas=pallas)(st, bt, now)
 
             gstate, gcfg = _apply_control(gstate, gcfg, upd, ups)
             gb = WindowBatch(*jax.tree.map(lambda a: a[0], gbatch))
-            new_g, gout = _global_window(gstate, gcfg, gb, gacc[0], now, mesh)
+            new_g, gout = _global_window(gstate, gcfg, gb, gacc[0], now, mesh, pallas)
 
             expand = lambda a: a[None]
             return (
@@ -1446,7 +1472,7 @@ def _compiled_step(mesh: Mesh):
         # the Pallas window kernel cannot carry vma tags through its
         # interpret-mode while_loop (jnp.take drops them); vma checking is
         # an XLA-path-only invariant here
-        check_vma=not _use_pallas(),
+        check_vma=not pallas,
         in_specs=(
             state_sharded,
             state_repl,
@@ -1468,8 +1494,12 @@ def _compiled_step(mesh: Mesh):
     return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
 
-@lru_cache(maxsize=None)
 def _compiled_step_compact(mesh: Mesh):
+    return _compiled_step_compact_impl(mesh, _use_pallas())
+
+
+@lru_cache(maxsize=None)
+def _compiled_step_compact_impl(mesh: Mesh, pallas: bool):
     """The serving fast path: compact request/response wire format.
 
     Same computation as _compiled_step, but the regular-key window crosses
@@ -1482,11 +1512,11 @@ def _compiled_step_compact(mesh: Mesh):
     def shard_fn(state, gstate, gcfg, packed, gbatch, gacc, upd, ups, now):
         st = BucketState(*jax.tree.map(lambda a: a[0], state))
         bt = kernel.decode_batch(packed[0])
-        new_st, out = _window_step_fn(mesh)(st, bt, now)
+        new_st, out = _window_step_fn(mesh, compact32=True, pallas=pallas)(st, bt, now)
 
         gstate, gcfg = _apply_control(gstate, gcfg, upd, ups)
         gb = WindowBatch(*jax.tree.map(lambda a: a[0], gbatch))
-        new_g, gout = _global_window(gstate, gcfg, gb, gacc[0], now, mesh)
+        new_g, gout = _global_window(gstate, gcfg, gb, gacc[0], now, mesh, pallas)
 
         expand = lambda a: a[None]
         gfused = jnp.stack(
@@ -1508,7 +1538,7 @@ def _compiled_step_compact(mesh: Mesh):
         # the Pallas window kernel cannot carry vma tags through its
         # interpret-mode while_loop (jnp.take drops them); vma checking is
         # an XLA-path-only invariant here
-        check_vma=not _use_pallas(),
+        check_vma=not pallas,
         in_specs=(
             state_sharded,
             state_repl,
@@ -1559,8 +1589,12 @@ def _compiled_global_register(mesh: Mesh):
                    out_shardings=(repl6, repl3))
 
 
-@lru_cache(maxsize=None)
 def _compiled_pipeline_step(mesh: Mesh):
+    return _compiled_pipeline_step_impl(mesh, _use_pallas())
+
+
+@lru_cache(maxsize=None)
+def _compiled_pipeline_step_impl(mesh: Mesh, pallas: bool):
     """K compact serving windows in ONE device dispatch — the drain
     executable of the serving pipeline (core/pipeline.py).
 
@@ -1590,7 +1624,7 @@ def _compiled_pipeline_step(mesh: Mesh):
         def body(st, xs):
             pk, now = xs
             bt = kernel.decode_batch(pk[0])
-            st, out = _window_step_fn(mesh)(st, bt, now)
+            st, out = _window_step_fn(mesh, compact32=True, pallas=pallas)(st, bt, now)
             word = kernel.encode_output_word(out, now)
             mism = jnp.any((out.limit != bt.limit) & (bt.slot >= 0))
             return st, (word, out.limit, mism)
@@ -1612,15 +1646,19 @@ def _compiled_pipeline_step(mesh: Mesh):
         # the Pallas window kernel cannot carry vma tags through its
         # interpret-mode while_loop (jnp.take drops them); vma checking is
         # an XLA-path-only invariant here
-        check_vma=not _use_pallas(),
+        check_vma=not pallas,
         in_specs=(state_sharded, stackedP, P()),
         out_specs=(state_sharded, stackedP, stackedP, stackedP),
     )
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-@lru_cache(maxsize=None)
 def _compiled_multi_step(mesh: Mesh):
+    return _compiled_multi_step_impl(mesh, _use_pallas())
+
+
+@lru_cache(maxsize=None)
+def _compiled_multi_step_impl(mesh: Mesh, pallas: bool):
     """K batching windows applied in ONE device dispatch via lax.scan.
 
     Each scanned iteration is a full serving window — its own timestamp, its
@@ -1646,9 +1684,9 @@ def _compiled_multi_step(mesh: Mesh):
             st, gst = carry
             b, gb, gacc, now = xs
             bt = WindowBatch(*jax.tree.map(lambda a: a[0], b))
-            st, out = _window_step_fn(mesh)(st, bt, now)
+            st, out = _window_step_fn(mesh, compact32=False, pallas=pallas)(st, bt, now)
             gbt = WindowBatch(*jax.tree.map(lambda a: a[0], gb))
-            gst, gout = _global_window(gst, gcfg, gbt, gacc[0], now, mesh)
+            gst, gout = _global_window(gst, gcfg, gbt, gacc[0], now, mesh, pallas)
             return (st, gst), kernel.pack_outputs(out, gout)
 
         (st, gst), fused = lax.scan(
@@ -1672,7 +1710,7 @@ def _compiled_multi_step(mesh: Mesh):
         # the Pallas window kernel cannot carry vma tags through its
         # interpret-mode while_loop (jnp.take drops them); vma checking is
         # an XLA-path-only invariant here
-        check_vma=not _use_pallas(),
+        check_vma=not pallas,
         in_specs=(
             state_sharded,
             state_repl,

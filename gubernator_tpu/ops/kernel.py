@@ -171,10 +171,16 @@ def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh):
     L, D, R, T, E, A = reg
     h = hits
     is_token = req_algo == TOKEN_BUCKET
+    # counter dtype follows the inputs: i64 normally; the Pallas TPU path
+    # runs the same ladder in rebased i32 (Mosaic has no 64-bit vectors,
+    # and the compact-format range caps make i32 exact — see
+    # ops/pallas_kernel.py)
+    Z = jnp.asarray(0, h.dtype)
+    ONE = jnp.asarray(1, h.dtype)
 
     # ---- init path (cache miss): algorithms.go:68-84 / :161-185 ----
     over_init = h > req_limit
-    init_R = jnp.where(over_init, jnp.int64(0), req_limit - h)
+    init_R = jnp.where(over_init, Z, req_limit - h)
     init_status = jnp.where(over_init, OVER_LIMIT, UNDER_LIMIT).astype(I32)
     # token stores reset_time = now+duration (:69-74); leaky stores
     # TimeStamp = now (:166) and its init response has ResetTime 0 (:173).
@@ -191,7 +197,7 @@ def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh):
         status=init_status,
         limit=req_limit,
         remaining=init_R,
-        reset_time=jnp.where(is_token, now + req_duration, jnp.int64(0)),
+        reset_time=jnp.where(is_token, now + req_duration, Z),
     )
 
     # ---- token bucket hit path: algorithms.go:40-65 ----
@@ -204,11 +210,11 @@ def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh):
         UNDER_LIMIT,
     ).astype(I32)
     t_resp_R = _chain(
-        [(tb_at_zero, jnp.int64(0)), (tb_read, R), (tb_drain, jnp.int64(0)), (tb_over, R)],
+        [(tb_at_zero, Z), (tb_read, R), (tb_drain, Z), (tb_over, R)],
         R - h,
     )
     t_new_R = _chain(
-        [(tb_at_zero, R), (tb_read, R), (tb_drain, jnp.int64(0)), (tb_over, R)],
+        [(tb_at_zero, R), (tb_read, R), (tb_drain, Z), (tb_over, R)],
         R - h,
     )
     token_reg = _Reg(limit=L, duration=D, remaining=t_new_R, tstamp=T, expire=E, algo=A)
@@ -218,10 +224,12 @@ def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh):
     # ---- leaky bucket hit path: algorithms.go:107-158 ----
     # rate = stored duration / REQUEST limit (:107) — a reference quirk we
     # keep; clamped to >=1ms where the reference would panic on a zero rate.
-    rate = D // jnp.maximum(req_limit, jnp.int64(1))
-    rate = jnp.maximum(rate, jnp.int64(1))
+    rate = D // jnp.maximum(req_limit, ONE)
+    rate = jnp.maximum(rate, ONE)
     leak = (now - T) // rate  # :110-111
-    R2 = jnp.minimum(R + leak, L)  # :113-115 clamp to stored limit
+    # :113-115 clamp to stored limit; written add-after-min (equivalent
+    # given R <= L) so the i32 Pallas path cannot overflow on R + leak
+    R2 = R + jnp.minimum(leak, L - R)
     T2 = jnp.where(h != 0, now, T)  # :118-121 ts advances only on hits
     lb_at_zero = R2 == 0  # :130-134 -> OVER, reset now+rate
     lb_drain = h == R2  # :136-141 -> UNDER, remaining -> 0, reset 0
@@ -232,15 +240,15 @@ def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh):
         UNDER_LIMIT,
     ).astype(I32)
     l_resp_R = _chain(
-        [(lb_at_zero, jnp.int64(0)), (lb_drain, jnp.int64(0)), (lb_over, R2), (lb_read, R2)],
+        [(lb_at_zero, Z), (lb_drain, Z), (lb_over, R2), (lb_read, R2)],
         R2 - h,
     )
     l_reset = _chain(
-        [(lb_at_zero, now + rate), (lb_drain, jnp.int64(0)), (lb_over, now + rate), (lb_read, jnp.int64(0))],
-        jnp.int64(0),
+        [(lb_at_zero, now + rate), (lb_drain, Z), (lb_over, now + rate), (lb_read, Z)],
+        Z,
     )
     l_new_R = _chain(
-        [(lb_at_zero, R2), (lb_drain, jnp.int64(0)), (lb_over, R2), (lb_read, R2)],
+        [(lb_at_zero, R2), (lb_drain, Z), (lb_over, R2), (lb_read, R2)],
         R2 - h,
     )
     # expiry extends only on a successful decrement (:155-157, with the
@@ -272,25 +280,31 @@ def uniform_closed_form(st: _Reg, fresh0, h0, l0, d0, a0, pos, seg_len, now):
     VMEM-resident pass.  Returns (final register, per-lane outputs)."""
     is_token0 = a0 == TOKEN_BUCKET
     init_over0 = h0 > l0
+    # dtype-generic like transition: i64 normally, rebased i32 on the
+    # Pallas TPU path
+    Z = jnp.asarray(0, h0.dtype)
+    ONE = jnp.asarray(1, h0.dtype)
 
     L_eff = jnp.where(fresh0, l0, st.limit)
     D_eff = jnp.where(fresh0, d0, st.duration)
     # token: reset_time is now+duration on init, stored otherwise
     T0_tok = jnp.where(fresh0, now + d0, st.tstamp)
-    rate0 = jnp.maximum(D_eff // jnp.maximum(l0, jnp.int64(1)), jnp.int64(1))
-    leak0 = jnp.where(fresh0, jnp.int64(0), (now - st.tstamp) // rate0)
+    rate0 = jnp.maximum(D_eff // jnp.maximum(l0, ONE), ONE)
+    leak0 = jnp.where(fresh0, Z, (now - st.tstamp) // rate0)
     r_start_tok = jnp.where(
-        fresh0, jnp.where(init_over0, jnp.int64(0), l0), st.remaining)
+        fresh0, jnp.where(init_over0, Z, l0), st.remaining)
     r_start_lky = jnp.where(
         fresh0,
-        jnp.where(init_over0, jnp.int64(0), l0),
-        jnp.minimum(st.remaining + leak0, L_eff),
+        jnp.where(init_over0, Z, l0),
+        # add-after-min (equivalent given remaining <= limit): no i32
+        # overflow on remaining + leak
+        st.remaining + jnp.minimum(leak0, L_eff - st.remaining),
     )
     r_start = jnp.where(is_token0, r_start_tok, r_start_lky)
-    kstar = jnp.minimum(seg_len.astype(I64), r_start // h0)
+    kstar = jnp.minimum(seg_len.astype(h0.dtype), r_start // h0)
     r_end = r_start - kstar * h0
 
-    posl = pos.astype(I64)
+    posl = pos.astype(h0.dtype)
     under = posl < kstar
     ff_rem = jnp.where(under, r_start - (posl + 1) * h0, r_end)
     ff_status = jnp.where(under, UNDER_LIMIT, OVER_LIMIT).astype(I32)
@@ -298,12 +312,19 @@ def uniform_closed_form(st: _Reg, fresh0, h0, l0, d0, a0, pos, seg_len, now):
     # very first lane of a fresh bucket, whose init response is always 0
     # (algorithms.go:169-181)
     lky_reset = jnp.where(
-        under | (fresh0 & (pos == 0)), jnp.int64(0), now + rate0)
+        under | (fresh0 & (pos == 0)), Z, now + rate0)
     ff_reset = jnp.where(is_token0, T0_tok, lky_reset)
     ff_out = WindowOutput(
         status=ff_status, limit=L_eff, remaining=ff_rem, reset_time=ff_reset)
 
-    consumed = kstar >= 1
+    # Leaky expiry extends only on GENERIC decrements (algorithms.go:
+    # 155-157) — the exact-drain branch (:136-141) leaves it untouched.
+    # Within a uniform run a drain can only be the LAST consume (h ==
+    # remaining ⇔ r_end hits 0), so the generic count is kstar minus one
+    # when r_end == 0; extension happened iff that count >= 1.  (Caught
+    # by the hypothesis fuzz: a lone exact drain must NOT re-arm a long
+    # TTL with the request's shorter duration.)
+    extended = (kstar - (r_end == 0)) >= 1
     ff_reg = _Reg(
         limit=L_eff,
         duration=D_eff,
@@ -312,7 +333,7 @@ def uniform_closed_form(st: _Reg, fresh0, h0, l0, d0, a0, pos, seg_len, now):
         expire=jnp.where(
             is_token0,
             jnp.where(fresh0, now + d0, st.expire),
-            jnp.where(fresh0 | consumed, now + d0, st.expire),
+            jnp.where(fresh0 | extended, now + d0, st.expire),
         ),
         algo=a0,
     )

@@ -207,13 +207,32 @@ def _window_math_kernel(now_ref, maxpos_ref,
     f_algo[:] = jnp.where(uniform, ff_reg.algo, alg)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "compact32"))
 def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
-                       interpret: bool = False
+                       interpret: bool = False, compact32: bool = False
                        ) -> tuple[BucketState, WindowOutput]:
     """Drop-in replacement for kernel.window_step with the window math in
     one Pallas kernel.  Sort, segment indexing, the arena gather, and the
-    final scatter/unsort stay in XLA (see the module docstring for why)."""
+    final scatter/unsort stay in XLA (see the module docstring for why).
+
+    compact32=True runs the kernel body entirely in int32 with times
+    REBASED to the window's `now` — Mosaic on real TPU has no 64-bit
+    vector types (round-4 probe: "64-bit types are not supported"), and
+    this is what makes the Pallas path runnable on hardware.  It is exact
+    iff every lane satisfies the compact wire-format ranges
+    (kernel.COMPACT_MAX_*: hits < 2^28, limit < 2^31, duration < 2^31-16)
+    AND the arena rows it reads were written under the same caps — both
+    guaranteed on the engine's compact serving path (the engine
+    permanently drops to the full-format XLA path the first time an
+    out-of-range config appears, core/engine.py _dispatch).  Rebased
+    time identities: every absolute time the ladder computes is now+X
+    with X in (-2^31, 2^31); non-fresh registers satisfy
+    |t - now| <= max request duration < 2^31-16 (token: tstamp = expire
+    >= now and <= write_now+duration; leaky: expire = last-decrement
+    now+duration >= now) PROVIDED the window clock is monotonic — the
+    engine's serving clocks are.  A clock that jumps backward by D ms
+    can push a stored time up to D past the rebase range; the clip then
+    bounds the resulting expiry error to D (graceful, not wrong-branch)."""
     B = batch.slot.shape[0]
     now = jnp.asarray(now, dtype=I64)
 
@@ -223,6 +242,24 @@ def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
     (_, _, s_valid, s_hits, s_limit, s_duration, s_algo, s_init,
      _, seg_start_idx, pos, seg_len, cur, fresh_seg, h0, l0, d0, a0,
      seg_uniform, max_pos, _commit_mask) = prep
+
+    if compact32:
+        lim = jnp.int64(2**31 - 16)
+        rel = lambda t: jnp.clip(t - now, -lim, lim).astype(I32)
+        cnt = lambda x: x.astype(I32)
+        k_hits, k_limit, k_dur = cnt(s_hits), cnt(s_limit), cnt(s_duration)
+        k_h0, k_l0, k_d0 = cnt(h0), cnt(l0), cnt(d0)
+        k_cur = _Reg(limit=cnt(cur.limit), duration=cnt(cur.duration),
+                     remaining=cnt(cur.remaining), tstamp=rel(cur.tstamp),
+                     expire=rel(cur.expire), algo=cur.algo)
+        k_now = jnp.zeros((1,), I32)
+        VD = I32
+    else:
+        k_hits, k_limit, k_dur = s_hits, s_limit, s_duration
+        k_h0, k_l0, k_d0 = h0, l0, d0
+        k_cur = cur
+        k_now = now.reshape((1,))
+        VD = I64
 
     # under shard_map with check_vma the window arrays vary over the shard
     # axis; mirror the input's vma on the outputs.  The engine disables
@@ -237,18 +274,37 @@ def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
         _window_math_kernel,
         in_specs=[sspec, sspec] + [spec] * 21,
         out_specs=[spec] * 10,
-        out_shape=[sds(I32), sds(I64), sds(I64), sds(I64),   # outputs
-                   sds(I64), sds(I64), sds(I64), sds(I64), sds(I64),
-                   sds(I32)],                                 # final regs
+        out_shape=[sds(I32), sds(VD), sds(VD), sds(VD),   # outputs
+                   sds(VD), sds(VD), sds(VD), sds(VD), sds(VD),
+                   sds(I32)],                             # final regs
         interpret=interpret,
-    )(now.reshape((1,)), max_pos.reshape((1,)),
-      s_valid, s_hits, s_limit, s_duration, s_algo, s_init,
+    )(k_now, max_pos.reshape((1,)),
+      s_valid, k_hits, k_limit, k_dur, s_algo, s_init,
       pos, seg_len, seg_start_idx, seg_uniform,
-      h0, l0, d0, a0, fresh_seg,
-      cur.limit, cur.duration, cur.remaining, cur.tstamp, cur.expire,
-      cur.algo)
+      k_h0, k_l0, k_d0, a0, fresh_seg,
+      k_cur.limit, k_cur.duration, k_cur.remaining, k_cur.tstamp,
+      k_cur.expire, k_cur.algo)
     out_sorted = WindowOutput(status=outs[0], limit=outs[1],
                               remaining=outs[2], reset_time=outs[3])
     fin = _Reg(limit=outs[4], duration=outs[5], remaining=outs[6],
                tstamp=outs[7], expire=outs[8], algo=outs[9])
+    if compact32:
+        # re-absolutize.  reset_time: leaky uses 0 as the "no reset"
+        # sentinel and every leaky non-zero reset is now+rate with
+        # rate >= 1, so rel == 0 distinguishes exactly; token lanes always
+        # carry a real time (rel 0 == "resets at now") and never the
+        # sentinel (algorithms.go:130-141 vs :69-74).
+        leaky_lane = s_algo == kernel.LEAKY_BUCKET
+        reset64 = jnp.where(
+            leaky_lane & (out_sorted.reset_time == 0), jnp.int64(0),
+            out_sorted.reset_time.astype(I64) + now)
+        out_sorted = WindowOutput(
+            status=out_sorted.status, limit=out_sorted.limit.astype(I64),
+            remaining=out_sorted.remaining.astype(I64), reset_time=reset64)
+        fin = _Reg(limit=fin.limit.astype(I64),
+                   duration=fin.duration.astype(I64),
+                   remaining=fin.remaining.astype(I64),
+                   tstamp=fin.tstamp.astype(I64) + now,
+                   expire=fin.expire.astype(I64) + now,
+                   algo=fin.algo)
     return kernel.window_commit(state, prep, fin, out_sorted)

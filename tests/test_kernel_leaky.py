@@ -150,3 +150,22 @@ def test_leaky_expiry_extended_only_by_decrement():
     h.advance(10)  # t0+95 > t0+90: expired
     r = h.one(req(hits=1, duration=50))
     assert r.remaining == 4
+
+
+def test_exact_drain_does_not_extend_expiry():
+    """A lone exact drain must NOT re-arm the entry's TTL (the reference
+    extends expiry only on the generic decrement, algorithms.go:155-157;
+    the drain branch :136-141 leaves it alone).  Found by the hypothesis
+    fuzz: entry created with a 400ms TTL, drained by a request carrying a
+    3ms duration — the entry must still be alive (and OVER) 42ms later,
+    not expired and re-initialized."""
+    h = KernelHarness()
+    r1 = h.one(req(key="drain", hits=6, limit=9, duration=400))
+    assert (r1.status, r1.remaining) == (Status.UNDER_LIMIT, 3)
+    # exact drain carrying a 3ms duration: must not shorten the live TTL
+    r2 = h.one(req(key="drain", hits=3, limit=1, duration=3))
+    assert (r2.status, r2.remaining) == (Status.UNDER_LIMIT, 0)
+    h.advance(42)
+    r3 = h.one(req(key="drain", hits=0, limit=1, duration=3))
+    assert (r3.status, r3.limit, r3.remaining) == (Status.OVER_LIMIT, 9, 0)
+    assert r3.reset_time == h.now + 400  # now + stored rate (400 // 1)

@@ -131,3 +131,79 @@ def test_engine_serves_with_pallas(monkeypatch):
     assert (r1.remaining, r2.remaining) == (8, 8)  # replica read lags psum
     r3 = eng.process(g, now=T0 + 12)[0]
     assert r3.remaining == 6  # both hits applied via the psum by now
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_pallas_compact32_matches_xla(seed):
+    """The rebased-int32 kernel (the only form Mosaic accepts on real
+    TPU) must be bit-exact with the int64 XLA path on compact-range
+    workloads — chained windows, hot duplicates, recycling inits,
+    zero-reads, expiry crossings, and near-cap configs."""
+    from gubernator_tpu.ops.pallas_kernel import window_step_pallas
+
+    rng = np.random.default_rng(90 + seed)
+    B, C = 128, 32
+    state_x = kernel.BucketState.zeros(C)
+    state_p = kernel.BucketState.zeros(C)
+    big_l = int(kernel.COMPACT_MAX_LIMIT - 1)
+    big_d = int(kernel.COMPACT_MAX_DURATION - 1)
+    big_h = int(kernel.COMPACT_MAX_HITS - 1)
+    now = T0
+    for w in range(6):
+        # MONOTONIC clock: i32 exactness needs |stored time - now| <=
+        # max duration, which a backward-jumping clock can break by the
+        # jump size (the clip then bounds the error to the jump) — the
+        # engine's serving clocks are monotonic by construction
+        now += int(rng.integers(1, 400))
+        batch = _random_window(rng, B, C)
+        # push some lanes to the compact-range caps (the i32 edge)
+        capped = rng.random(B) < 0.2
+        batch = kernel.WindowBatch(
+            slot=batch.slot,
+            hits=jnp.where(jnp.asarray(rng.random(B) < 0.1),
+                           jnp.int64(big_h), batch.hits),
+            limit=jnp.where(jnp.asarray(capped), jnp.int64(big_l),
+                            batch.limit),
+            duration=jnp.where(jnp.asarray(capped), jnp.int64(big_d),
+                               batch.duration),
+            algo=batch.algo,
+            is_init=batch.is_init,
+        )
+        state_x, out_x = kernel.window_step(state_x, batch, now)
+        state_p, out_p = window_step_pallas(state_p, batch, now,
+                                            interpret=True, compact32=True)
+        valid = np.asarray(batch.slot) >= 0
+        for name, x, p in zip(kernel.WindowOutput._fields, out_x, out_p):
+            np.testing.assert_array_equal(
+                np.asarray(x)[valid], np.asarray(p)[valid],
+                err_msg=f"window {w} out.{name}")
+        for name, x, p in zip(kernel.BucketState._fields, state_x, state_p):
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(p), err_msg=f"window {w} state.{name}")
+
+
+def test_engine_compact_serving_uses_compact32(monkeypatch):
+    """Under GUBER_PALLAS=1 the engine's compact serving path (pipeline
+    drain) runs the i32 kernel; responses must match a plain engine."""
+    import jax
+
+    from gubernator_tpu.api.types import RateLimitReq
+    from gubernator_tpu.core.engine import RateLimitEngine
+    from gubernator_tpu.parallel.mesh import make_mesh
+
+    monkeypatch.setenv("GUBER_PALLAS", "1")
+    mesh = make_mesh(jax.devices("cpu")[5:6])
+    eng = RateLimitEngine(mesh=mesh, capacity_per_shard=64,
+                          batch_per_shard=16, global_capacity=16,
+                          global_batch_per_shard=8, max_global_updates=8)
+    plain = RateLimitEngine(capacity_per_shard=64, batch_per_shard=16,
+                            global_capacity=16, global_batch_per_shard=8,
+                            max_global_updates=8)
+    assert eng._compact_enabled
+    for i in range(5):
+        reqs = [RateLimitReq(name="c32", unique_key=f"k{j % 3}", hits=1,
+                             limit=4, duration=60_000) for j in range(6)]
+        a = eng.process(reqs, now=T0 + i)
+        b = plain.process(reqs, now=T0 + i)
+        assert [(int(x.status), x.remaining, x.reset_time) for x in a] == \
+            [(int(y.status), y.remaining, y.reset_time) for y in b], i
