@@ -64,6 +64,12 @@ class EngineConfig:
     # collision probes onward instead of merging two keys' counters.
     # Costs ~key-length bytes per resident key.
     exact_keys: bool = False
+    # Replay-bound guard (env: GUBER_REPLAY_CAP): max lanes of a
+    # NON-uniform duplicate-key run per device window before the native
+    # router splits the window (bounds the kernel's replay loop against
+    # mixed-config hot-key floods).  0 disables; uniform duplicates are
+    # never split (the closed form is O(1) in run length).
+    replay_cap: int = 128
 
 
 @dataclass
@@ -231,5 +237,9 @@ def config_from_env(env_file: Optional[str] = None) -> DaemonConfig:
         e.batch_per_shard = int(_env("GUBER_TPU_BATCH_PER_SHARD"))
     if _env("GUBER_TPU_GLOBAL_CAPACITY"):
         e.global_capacity = int(_env("GUBER_TPU_GLOBAL_CAPACITY"))
+    if _env("GUBER_EXACT_KEYS"):
+        e.exact_keys = _env("GUBER_EXACT_KEYS") == "1"
+    if _env("GUBER_REPLAY_CAP"):
+        e.replay_cap = int(_env("GUBER_REPLAY_CAP"))
 
     return c

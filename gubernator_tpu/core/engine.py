@@ -133,6 +133,7 @@ class RateLimitEngine:
         max_global_updates: int = 256,
         use_native: str = "auto",
         exact_keys: bool = False,
+        replay_cap: "Optional[int]" = None,
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.num_shards = int(np.prod(list(self.mesh.shape.values())))
@@ -232,6 +233,12 @@ class RateLimitEngine:
         # shard routing, slot lookup + LRU in one C call per window, replacing
         # the per-key Python dict path.  The two backends are exclusive —
         # regular-key routing state lives in exactly one of them.
+        # replay-bound guard (GUBER_REPLAY_CAP overrides the param/config
+        # unconditionally, like GUBER_EXACT_KEYS; default 128, 0 disables)
+        import os as _os
+        _env_cap = _os.environ.get("GUBER_REPLAY_CAP")
+        self.replay_cap = (int(_env_cap) if _env_cap is not None
+                           else (128 if replay_cap is None else replay_cap))
         self.native = None
         if use_native in ("auto", True, "on"):
             from gubernator_tpu import native as native_mod
@@ -247,6 +254,7 @@ class RateLimitEngine:
                 import os
                 if exact_keys or os.environ.get("GUBER_EXACT_KEYS") == "1":
                     self.native.set_exact_keys()
+                self.native.set_replay_cap(self.replay_cap)
             elif use_native != "auto":
                 raise RuntimeError("native router requested but unavailable")
 
@@ -1288,11 +1296,20 @@ class RateLimitEngine:
     def max_window_prefix(self, requests: Sequence[RateLimitReq]) -> int:
         """How many leading requests fit in ONE step() window (>=1 when any
         are given).  Shared by process() chunking and the lockstep batcher's
-        per-tick window assembly."""
+        per-tick window assembly.
+
+        Also enforces the replay-bound guard on this FULL-FORMAT path (the
+        stacked compact paths enforce it natively — host_router.cc
+        rep_track): a NON-uniform duplicate-key run longer than replay_cap
+        lanes cuts the window there, so the kernel's per-window replay loop
+        stays bounded even for traffic that fell off the compact path
+        (e.g. after an out-of-range config permanently disabled it)."""
         S, SL = self.num_shards, self.num_local_shards
         reg_fill = [0] * SL
         g_count = 0
         gkeys: set = set()
+        cap = self.replay_cap
+        runs: dict = {}  # key -> [first (h,l,d,a), lanes, nonuniform]
         for i, r in enumerate(requests):
             key = r.hash_key()
             if r.behavior == Behavior.GLOBAL:
@@ -1310,6 +1327,17 @@ class RateLimitEngine:
                         "not owned by this process")
                 if reg_fill[s] + 1 > self.batch_per_shard:
                     return max(i, 1)
+                if cap:
+                    tup = (r.hits, r.limit, r.duration, r.algorithm)
+                    run = runs.get(key)
+                    if run is None:
+                        runs[key] = [tup, 1, r.hits == 0]
+                    else:
+                        run[1] += 1
+                        if not run[2] and (tup != run[0] or r.hits == 0):
+                            run[2] = True
+                        if run[2] and run[1] > cap:
+                            return max(i, 1)
                 reg_fill[s] += 1
         return len(requests)
 

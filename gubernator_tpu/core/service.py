@@ -71,6 +71,7 @@ class Instance:
             global_batch_per_shard=e.global_batch_per_shard,
             max_global_updates=e.max_global_updates,
             exact_keys=e.exact_keys,
+            replay_cap=e.replay_cap,
         )
         self.metrics.watch_engine(self.engine)
         self.mesh_mode = mesh_peers is not None

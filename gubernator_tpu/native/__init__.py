@@ -71,6 +71,9 @@ def _load() -> Optional[ctypes.CDLL]:
                    "router_set_exact"):
             getattr(lib, fn).restype = None
             getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.router_set_replay_cap.restype = None
+        lib.router_set_replay_cap.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_int32]
         lib.fastpath_parse_stack.restype = ctypes.c_int64
         lib.fastpath_parse_stack.argtypes = [
             ctypes.c_void_p, u8p, ctypes.c_int64, ctypes.c_int64,
@@ -199,6 +202,17 @@ class NativeRouter:
         fingerprint collision then probes onward instead of merging two
         keys' counters).  Call before any key is inserted."""
         self._lib.router_set_exact(self._handle)
+
+    def set_replay_cap(self, cap: int) -> None:
+        """Bound on a NON-uniform duplicate-key run per device window:
+        when one key accumulates `cap` mixed-config/zero-hit lanes in a
+        window, its next lane opens a fresh window of the stack, keeping
+        the kernel's per-window replay loop bounded (an unbounded replay
+        is a multi-hundred-ms device execution — a DoS lever through the
+        public RPC surface, and large enough ones crashed the TPU runtime
+        worker).  Uniform hot-key duplicates are unaffected (closed form).
+        0 disables; the default is 128."""
+        self._lib.router_set_replay_cap(self._handle, int(cap))
 
     def fastpath_parse_stack(self, data: bytes, now: int, lanes: int,
                              K: int, max_items: int, packed: np.ndarray,

@@ -118,6 +118,18 @@ struct Shard {
   int32_t* klen;
 };
 
+// One tracked key's segment stats for the replay-bound guard.  A cell is
+// live iff seq == Router::drain_seq (stamp-validated: no per-drain clear).
+struct RepCell {
+  uint64_t fp;       // 0 = empty slot in the map
+  int64_t h, l, d;   // the segment's first-lane request tuple
+  uint32_t seq;
+  int32_t shard;
+  int32_t algo;
+  int32_t lanes;     // lanes staged for this key in its current window
+  int32_t nonuniform;  // 1 once any lane broke the uniform pattern
+};
+
 struct Router {
   Shard* shards;
   int32_t num_shards;         // local shards staged by this process
@@ -136,6 +148,15 @@ struct Router {
   int32_t* ring_peer;         // peer index per point
   int32_t ring_len;
   int32_t ring_self;          // this node's peer index
+  // replay-bound tracker (see rep_track): per-drain open-addressing map
+  // (shard, fp) -> this key's current-window segment stats, used to split
+  // windows so the device kernel's per-window replay loop stays bounded.
+  RepCell* rep;
+  int64_t rep_cap;            // power of two, grown on load
+  int64_t rep_live;           // live cells this drain (load control)
+  uint32_t drain_seq;         // validity stamp (bumped per drain)
+  int32_t replay_cap;         // max lanes of a NON-uniform segment per
+                              // window; 0 disables the guard
 };
 
 uint32_t next_pow2(uint32_t v) {
@@ -445,7 +466,18 @@ Router* router_new_mesh(int32_t num_global_shards, int32_t shard_offset,
   r->ring_peer = nullptr;
   r->ring_len = 0;
   r->ring_self = -1;
+  r->rep = nullptr;
+  r->rep_cap = 0;
+  r->rep_live = 0;
+  r->drain_seq = 0;
+  r->replay_cap = 128;  // see rep_track; router_set_replay_cap overrides
   return r;
+}
+
+// Bound on NON-uniform duplicate-key segment length per device window
+// (the kernel replays such segments one lane per round).  0 disables.
+void router_set_replay_cap(Router* r, int32_t cap) {
+  r->replay_cap = cap < 0 ? 0 : cap;
 }
 
 // Install (or clear, n == 0) the cluster's consistent-hash ring so
@@ -496,6 +528,8 @@ void router_set_exact(Router* r) {
 // never saw the failed windows).
 void router_drain_begin(Router* r) {
   r->pack_seq++;
+  r->drain_seq++;   // invalidates every replay-guard cell (stamp check)
+  r->rep_live = 0;
   // belt-and-braces: a crashed previous drain that called neither commit
   // nor abort must not have its pending inits cleared by THIS drain's
   // commit (the entries stay pending, so their next touch re-inits)
@@ -536,6 +570,7 @@ void router_free(Router* r) {
   free(r->scratch);
   free(r->ring_points);
   free(r->ring_peer);
+  free(r->rep);
   free(r);
 }
 
@@ -772,6 +807,82 @@ bool parse_item(const uint8_t* q, const uint8_t* qend, ParsedItem* it,
 // `demand[s]` more lanes be placed for every shard, given the monotonic
 // window cursors?  (Windows fill per shard in cursor order, so the free
 // space is the tail of the cursor's window plus every later window.)
+// ---- replay-bound guard -------------------------------------------------
+// The device kernel replays a NON-uniform duplicate-key segment one lane
+// per while_loop round; an RPC carrying thousands of same-key lanes with
+// mixed configs would compile into one multi-hundred-ms device execution
+// (big enough ones crashed the TPU runtime worker — round-4 finding).
+// Uniform hot keys are untouched (the closed form is O(1) regardless of
+// length).  When a key's segment is known non-uniform and reaches
+// replay_cap lanes in the current window, the NEXT lane forces its shard
+// onto a fresh window of the stack, bounding every window's replay depth.
+//
+// Tracking runs in the side-effect-free pass 1 (keyed by (shard, fp) —
+// the slot is not known until pass 2).  On a pack that later falls back,
+// the counts persist for the drain: purely conservative (an earlier
+// split next time), never wrong.
+
+RepCell* rep_probe(Router* r, int32_t shard, uint64_t fp) {
+  if (r->rep_cap == 0) {
+    r->rep_cap = 1024;
+    r->rep = (RepCell*)calloc(r->rep_cap, sizeof(RepCell));
+  }
+  uint64_t mask = (uint64_t)r->rep_cap - 1;
+  uint64_t h = fp ^ ((uint64_t)(uint32_t)shard * 0x9E3779B97F4A7C15ull);
+  for (int64_t probe = 0;; probe++) {
+    RepCell* c = &r->rep[(h + probe) & mask];
+    if (c->seq != r->drain_seq || c->fp == 0) return c;  // free (stale ok)
+    if (c->fp == fp && c->shard == shard) return c;
+    if (probe >= r->rep_cap) return nullptr;  // table saturated
+  }
+}
+
+void rep_grow(Router* r) {
+  int64_t old_cap = r->rep_cap;
+  RepCell* old = r->rep;
+  r->rep_cap = old_cap * 2;
+  r->rep = (RepCell*)calloc(r->rep_cap, sizeof(RepCell));
+  uint64_t mask = (uint64_t)r->rep_cap - 1;
+  for (int64_t i = 0; i < old_cap; i++) {
+    if (old[i].seq != r->drain_seq || old[i].fp == 0) continue;
+    uint64_t h = old[i].fp ^
+                 ((uint64_t)(uint32_t)old[i].shard * 0x9E3779B97F4A7C15ull);
+    for (int64_t probe = 0;; probe++) {
+      RepCell* c = &r->rep[(h + probe) & mask];
+      if (c->seq != r->drain_seq || c->fp == 0) { *c = old[i]; break; }
+    }
+  }
+  free(old);
+}
+
+// Track one local item; returns 1 if it must open a new window for its
+// shard (the caller accounts the spill and pass 2 honors it).
+inline int rep_track(Router* r, int32_t shard, uint64_t fp, int64_t h,
+                     int64_t l, int64_t d, int32_t algo) {
+  if (!r->replay_cap) return 0;
+  if (fp == 0) fp = 1;
+  if (r->rep_cap && r->rep_live * 2 >= r->rep_cap) rep_grow(r);
+  RepCell* c = rep_probe(r, shard, fp);
+  if (!c) return 0;  // saturated: guard degrades to off for new keys
+  if (c->seq != r->drain_seq || c->fp == 0 ||
+      !(c->fp == fp && c->shard == shard)) {
+    r->rep_live++;
+    *c = RepCell{fp, h, l, d, r->drain_seq, shard, algo, 1,
+                 h == 0};
+    return 0;
+  }
+  c->lanes++;
+  if (!c->nonuniform &&
+      !(h == c->h && l == c->l && d == c->d && algo == c->algo && h > 0))
+    c->nonuniform = 1;
+  if (c->nonuniform && c->lanes > r->replay_cap) {
+    // this lane starts the key's segment in a FRESH window
+    *c = RepCell{fp, h, l, d, r->drain_seq, shard, algo, 1, h == 0};
+    return 1;
+  }
+  return 0;
+}
+
 bool stack_fits(const int64_t* demand, const int32_t* kcur,
                 const int32_t* shard_fill, int32_t S, int32_t lanes,
                 int32_t K) {
@@ -793,8 +904,12 @@ inline void stage_lane(Router* r, int32_t shard, uint64_t fp,
                        int64_t hits, int64_t limit, int64_t duration,
                        uint32_t algo, int32_t lanes, int32_t K,
                        int64_t* packed, int32_t* kcur, int32_t* shard_fill,
-                       int32_t* out_row, int32_t* out_lane, int64_t i) {
+                       int32_t* out_row, int32_t* out_lane, int64_t i,
+                       int force_new) {
   int32_t S = r->num_shards;
+  // replay-bound split (rep_track said so in pass 1): this lane opens a
+  // fresh window for its shard so the device replay loop stays bounded
+  if (force_new && shard_fill[kcur[shard] * S + shard] > 0) kcur[shard]++;
   int32_t k = kcur[shard];
   if (shard_fill[k * S + shard] >= lanes) k = ++kcur[shard];
   int32_t lane = shard_fill[k * S + shard]++;
@@ -884,9 +999,13 @@ int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
   if (S > MAX_STACK_SHARDS) return -2;
   if (max_items > MAX_STACK_ITEMS) max_items = MAX_STACK_ITEMS;
   static thread_local ParsedItem items[MAX_STACK_ITEMS];
+  static thread_local uint8_t bump[MAX_STACK_ITEMS];
   int64_t demand[MAX_STACK_SHARDS] = {0};
+  int64_t extra_windows[MAX_STACK_SHARDS] = {0};
 
-  // ---- pass 1: parse + validate + hash, no side effects ----
+  // ---- pass 1: parse + validate + hash, no side effects on the router
+  //      tables (the replay-bound tracker is drain-scoped and purely
+  //      conservative on aborted packs — see rep_track) ----
   const uint8_t* p = buf;
   const uint8_t* end = buf + len;
   int64_t n = 0;
@@ -940,6 +1059,7 @@ int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
       int32_t owner = ring_owner(r, crc);
       if (owner != r->ring_self) {
         it->owner = owner;  // forwarded: parsed but never staged
+        bump[n] = 0;
         n++;
         continue;
       }
@@ -956,12 +1076,17 @@ int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
     if (shard < 0 || shard >= S) return -2;  // not ours: full path routes it
     it->shard = shard;
     demand[shard]++;
+    bump[n] = (uint8_t)rep_track(r, shard, it->fp, it->hits, it->limit,
+                                 it->duration, (int32_t)it->algo);
+    extra_windows[shard] += bump[n];
     if (r->exact) {
       it->scratch_off = scratch_need;
       scratch_need += it->name_len + 1 + it->key_len;
     }
     n++;
   }
+  for (int32_t s = 0; s < S; s++)  // each split wastes < one window
+    demand[s] += extra_windows[s] * lanes;
   if (!stack_fits(demand, kcur, shard_fill, S, lanes, K)) return -6;
 
   // ---- pass 2: stage (cannot fail) ----
@@ -988,7 +1113,7 @@ int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
     }
     stage_lane(r, it->shard, it->fp, kb, kl, now, it->hits, it->limit,
                it->duration, it->algo, lanes, K, packed, kcur, shard_fill,
-               out_row, out_lane, i);
+               out_row, out_lane, i, bump[i]);
     out_limit[i] = it->limit;
   }
   return n;
@@ -1013,7 +1138,9 @@ int64_t router_pack_stack(Router* r, const uint8_t* key_bytes,
   if (n > MAX_STACK_ITEMS) return -3;
   static thread_local uint64_t fps[MAX_STACK_ITEMS];
   static thread_local int32_t shards[MAX_STACK_ITEMS];
+  static thread_local uint8_t bump2[MAX_STACK_ITEMS];
   int64_t demand[MAX_STACK_SHARDS] = {0};
+  int64_t extra_windows[MAX_STACK_SHARDS] = {0};
 
   for (int64_t i = 0; i < n; i++) {
     if (hits[i] < 0 || hits[i] >= COMPACT_MAX_HITS) return -2;
@@ -1030,14 +1157,20 @@ int64_t router_pack_stack(Router* r, const uint8_t* key_bytes,
     shards[i] = shard;
     fps[i] = fnv1a64(key, len);
     demand[shard]++;
+    bump2[i] = (uint8_t)rep_track(r, shard, fps[i], hits[i], limits[i],
+                                  durations[i], algos[i]);
+    extra_windows[shard] += bump2[i];
   }
+  for (int32_t s = 0; s < S; s++)  // each split wastes < one window
+    demand[s] += extra_windows[s] * lanes;
   if (!stack_fits(demand, kcur, shard_fill, S, lanes, K)) return -6;
 
   for (int64_t i = 0; i < n; i++) {
     int64_t beg = i == 0 ? 0 : key_ends[i - 1];
     stage_lane(r, shards[i], fps[i], key_bytes + beg, key_ends[i] - beg,
                now, hits[i], limits[i], durations[i], (uint32_t)algos[i],
-               lanes, K, packed, kcur, shard_fill, out_row, out_lane, i);
+               lanes, K, packed, kcur, shard_fill, out_row, out_lane, i,
+               bump2[i]);
   }
   return n;
 }

@@ -1,0 +1,138 @@
+"""Replay-bound guard: a NON-uniform duplicate-key run is split across
+windows by the native router so the kernel's per-window replay loop stays
+bounded (host_router.cc rep_track).  An unbounded run is a device
+execution of thousands of while_loop rounds — a DoS lever through the
+public RPC surface (and big enough ones crashed the TPU runtime worker,
+round-4 finding).  Uniform hot-key duplicates must NOT split: the closed
+form handles any length in O(1).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import gubernator_tpu  # noqa: F401
+from gubernator_tpu import native
+from gubernator_tpu.api.types import RateLimitReq
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.core.batcher import WindowBatcher
+from gubernator_tpu.core.engine import RateLimitEngine, shard_of
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native router unavailable")
+
+T0 = 1_700_000_000_000
+CAP = 16  # small cap so tests stay fast
+
+
+def _engine(use_native, lanes=512):
+    return RateLimitEngine(capacity_per_shard=1024, batch_per_shard=lanes,
+                           global_capacity=16, global_batch_per_shard=8,
+                           max_global_updates=8, use_native=use_native)
+
+
+def _pack_run(eng, reqs, now, K=8, lanes=512):
+    """Stage reqs through router_pack_stack; returns (kcur, per-window
+    lane counts for shard 0)."""
+    nat = eng.native
+    nat.set_replay_cap(CAP)
+    nat.drain_begin()
+    S = eng.num_shards
+    packed = np.zeros((K, S, lanes, 2), np.int64)
+    kcur = np.zeros(S, np.int32)
+    fills = np.zeros((K, S), np.int32)
+    keys = b"".join(r.hash_key().encode() for r in reqs)
+    ends = np.cumsum([len(r.hash_key().encode()) for r in reqs]
+                     ).astype(np.int64)
+    n = len(reqs)
+    rc = nat.pack_stack(
+        np.frombuffer(keys, np.uint8), ends,
+        np.asarray([r.hits for r in reqs], np.int64),
+        np.asarray([r.limit for r in reqs], np.int64),
+        np.asarray([r.duration for r in reqs], np.int64),
+        np.asarray([r.algorithm for r in reqs], np.int32),
+        now, lanes, K, packed, kcur,
+        fills, np.empty(n, np.int32), np.empty(n, np.int32))
+    assert rc == n, rc
+    nat.commit()
+    return kcur, fills
+
+
+def test_nonuniform_run_splits_windows():
+    eng = _engine("on")
+    # one key, alternating limits: every lane after the first is irregular
+    reqs = [RateLimitReq(name="atk", unique_key="x", hits=1,
+                        limit=5 + (i % 2), duration=60_000)
+            for i in range(100)]
+    s = shard_of(reqs[0].hash_key(), eng.num_shards)
+    kcur, fills = _pack_run(eng, reqs, T0)
+    # windows split at the cap: no window carries more than CAP lanes of
+    # the run
+    assert kcur[s] >= 100 // (CAP + 1) - 1, kcur
+    assert (fills[:, s] <= CAP).all(), fills[:, s]
+    assert fills.sum() == 100
+
+
+def test_uniform_run_does_not_split():
+    eng = _engine("on")
+    reqs = [RateLimitReq(name="hot", unique_key="h", hits=1, limit=1000,
+                        duration=60_000) for _ in range(200)]
+    s = shard_of(reqs[0].hash_key(), eng.num_shards)
+    kcur, fills = _pack_run(eng, reqs, T0)
+    assert kcur[s] == 0          # single window
+    assert fills[0, s] == 200    # all lanes together (closed form is O(1))
+
+
+def test_split_preserves_sequential_semantics():
+    """Responses through the pipeline (with splitting active at a tiny
+    cap) must equal the plain Python engine lane for lane."""
+    eng = _engine("on", lanes=64)
+    ref = _engine(False, lanes=64)
+    eng.native.set_replay_cap(8)
+    b = WindowBatcher(eng, BehaviorConfig())
+    assert b.pipeline is not None and b.pipeline.enabled
+    b.pipeline.now_fn = lambda: T0
+
+    reqs = [RateLimitReq(name="seq", unique_key="k", hits=(i % 3),
+                        limit=40, duration=60_000) for i in range(50)]
+
+    async def run():
+        return await asyncio.gather(*(b.submit(r) for r in reqs))
+
+    got = asyncio.run(run())
+    b.close()
+    want = ref.process(reqs, now=T0)
+    for j, (g, w) in enumerate(zip(got, want)):
+        assert (int(g.status), g.limit, g.remaining, g.reset_time) == \
+            (int(w.status), w.limit, w.remaining, w.reset_time), j
+
+
+def test_full_format_path_is_guarded_too():
+    """After an out-of-range config permanently disables the compact path,
+    the FULL-format staging must still bound non-uniform runs — via
+    max_window_prefix chunking (an attacker must not be able to disable
+    the guard by first sending one huge-limit request)."""
+    eng = _engine(False, lanes=512)
+    eng.replay_cap = 8
+    reqs = [RateLimitReq(name="fp", unique_key="x", hits=1,
+                        limit=5 + (i % 2), duration=60_000)
+            for i in range(40)]
+    # chunk boundaries respect the cap...
+    prefix = eng.max_window_prefix(reqs)
+    assert prefix <= 9
+    # ...and process() still serves the whole list with exact sequential
+    # semantics across the cuts
+    ref = _engine(False, lanes=512)
+    got = eng.process(reqs, now=T0)
+    want = ref.process(reqs, now=T0)
+    for j, (g, w) in enumerate(zip(got, want)):
+        assert (int(g.status), g.remaining) == (int(w.status), w.remaining), j
+
+
+def test_uniform_full_format_not_chunked():
+    eng = _engine(False, lanes=512)
+    eng.replay_cap = 8
+    reqs = [RateLimitReq(name="fp2", unique_key="u", hits=1, limit=1000,
+                        duration=60_000) for _ in range(200)]
+    assert eng.max_window_prefix(reqs) == 200
