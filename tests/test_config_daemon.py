@@ -139,3 +139,24 @@ def test_exact_keys_engine_plumb(clean_env):
     r = eng.process([RateLimitReq(name="x", unique_key="k", hits=1,
                                   limit=5, duration=1000)], now=1)[0]
     assert r.remaining == 4
+
+
+def test_replay_cap_env(clean_env):
+    """GUBER_REPLAY_CAP reaches both the daemon config and the engine
+    (env wins over the param, mirroring GUBER_EXACT_KEYS)."""
+    clean_env.setenv("GUBER_REPLAY_CAP", "7")
+    c = config_from_env()
+    assert c.engine.replay_cap == 7
+    from gubernator_tpu.core.engine import RateLimitEngine
+    eng = RateLimitEngine(capacity_per_shard=32, batch_per_shard=8,
+                          global_capacity=8, global_batch_per_shard=4,
+                          max_global_updates=4, replay_cap=99)
+    assert eng.replay_cap == 7  # env overrides the param
+
+
+def test_replay_cap_default(clean_env):
+    from gubernator_tpu.core.engine import RateLimitEngine
+    eng = RateLimitEngine(capacity_per_shard=32, batch_per_shard=8,
+                          global_capacity=8, global_batch_per_shard=4,
+                          max_global_updates=4)
+    assert eng.replay_cap == 128
