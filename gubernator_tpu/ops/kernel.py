@@ -393,11 +393,19 @@ def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
     order = jnp.argsort(sort_key)
     s_slot = sort_key[order]
     s_valid = valid[order]
-    s_hits = batch.hits[order]
-    s_limit = batch.limit[order]
-    s_duration = batch.duration[order]
-    s_algo = batch.algo[order]
-    s_init = batch.is_init[order]
+    # Permute the request fields as ONE packed [B, 5] row gather instead of
+    # five separate gathers: gather/scatter launches are a measured fixed
+    # cost per op on remote runtimes (BENCH_NOTES round 4), and the
+    # pack/unpack is elementwise (fused, effectively free).
+    packed_req = jnp.stack(
+        [batch.hits, batch.limit, batch.duration,
+         batch.algo.astype(I64), batch.is_init.astype(I64)], axis=-1)
+    s_req = packed_req[order]
+    s_hits = s_req[:, 0]
+    s_limit = s_req[:, 1]
+    s_duration = s_req[:, 2]
+    s_algo = s_req[:, 3].astype(I32)
+    s_init = s_req[:, 4].astype(jnp.bool_)
 
     idx = jnp.arange(B, dtype=I32)
     phys_start = jnp.concatenate(
@@ -437,17 +445,22 @@ def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
     # expiry (lru.go:110: expireAt < now).  Algorithm switches are detected
     # per-round against the live register.
     cur_fresh = s_init | (cur.expire < now)
-    fresh_seg = cur_fresh[seg_start_idx]
 
     # Uniform-segment classification: a hot key's duplicates are usually
     # identical requests (same hits>0 and config); those take the closed
     # form (uniform_closed_form).  Only *irregular* segments (mixed
     # hits/config, zero-hit reads) replay — is_init lanes can't appear
     # mid-segment anymore (they start their own virtual segment above).
-    h0 = s_hits[seg_start_idx]
-    l0 = s_limit[seg_start_idx]
-    d0 = s_duration[seg_start_idx]
-    a0 = s_algo[seg_start_idx]
+    # Segment-start replication: one packed row gather instead of five.
+    packed_seg = jnp.stack(
+        [s_hits, s_limit, s_duration, s_algo.astype(I64),
+         cur_fresh.astype(I64)], axis=-1)
+    seg0 = packed_seg[seg_start_idx]
+    h0 = seg0[:, 0]
+    l0 = seg0[:, 1]
+    d0 = seg0[:, 2]
+    a0 = seg0[:, 3].astype(I32)
+    fresh_seg = seg0[:, 4].astype(jnp.bool_)
     lane_ok = (
         (s_hits == h0) & (s_limit == l0) & (s_duration == d0)
         & (s_algo == a0)
@@ -484,8 +497,16 @@ def window_commit(state: BucketState, prep: WindowPrep, fin: _Reg,
         expire=state.expire.at[wslot].set(fin.expire, mode="drop"),
         algo=state.algo.at[wslot].set(fin.algo, mode="drop"),
     )
-    unsorted = WindowOutput(*jax.tree.map(
-        lambda o: jnp.zeros_like(o).at[prep.order].set(o), outs_sorted))
+    # Un-sort via ONE packed row scatter instead of four per-field scatters
+    # (per-op launch cost, see window_prep note); unpack is fused slices.
+    B = prep.order.shape[0]
+    packed_out = jnp.stack(
+        [outs_sorted.status.astype(I64), outs_sorted.limit,
+         outs_sorted.remaining, outs_sorted.reset_time], axis=-1)
+    unpacked = jnp.zeros((B, 4), I64).at[prep.order].set(packed_out)
+    unsorted = WindowOutput(
+        status=unpacked[:, 0].astype(I32), limit=unpacked[:, 1],
+        remaining=unpacked[:, 2], reset_time=unpacked[:, 3])
     return new_state, unsorted
 
 
@@ -506,7 +527,24 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
      a0, seg_uniform, max_pos, _commit_mask) = prep
     cur_fresh = s_init | (cur.expire < now)
 
-    st = _Reg(*jax.tree.map(lambda a: a[seg_start_idx], cur))
+    # Registers travel PACKED as one [B, 7] row array (the seventh column
+    # is the per-lane fresh flag): the closed-form segment gather and every
+    # replay round are then one row gather + one row scatter instead of
+    # 6-7 per-field launches — per-op launch cost is a measured fixed cost
+    # on remote runtimes (BENCH_NOTES round 4).
+    def pack_reg(reg, fresh):
+        return jnp.stack(
+            [reg.limit, reg.duration, reg.remaining, reg.tstamp,
+             reg.expire, reg.algo.astype(I64), fresh.astype(I64)], axis=-1)
+
+    def unpack_reg(rows):
+        return _Reg(limit=rows[:, 0], duration=rows[:, 1],
+                    remaining=rows[:, 2], tstamp=rows[:, 3],
+                    expire=rows[:, 4],
+                    algo=rows[:, 5].astype(I32)), rows[:, 6] != 0
+
+    cur_packed = pack_reg(cur, cur_fresh)
+    st, _ = unpack_reg(cur_packed[seg_start_idx])
     fresh0 = fresh_seg | (a0 != st.algo)
     ff_reg, ff_out = uniform_closed_form(
         st, fresh0, h0, l0, d0, a0, pos, seg_len, now)
@@ -516,34 +554,32 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
     outs = ff_out
 
     def round_body(carry):
-        p, cur, cur_fresh, outs = carry
+        p, cur_packed, outs = carry
         active = (pos == p) & s_valid & ~seg_uniform
-        reg = jax.tree.map(lambda a: a[seg_start_idx], cur)
-        reg = _Reg(*reg)
+        reg, reg_fresh = unpack_reg(cur_packed[seg_start_idx])
         # fresh: segment-level miss (expired/new/init at window start — an
         # is_init lane always starts its own virtual segment, so its flag
-        # is carried by cur_fresh until its round clears it) or an
+        # is carried in the packed rows until its round clears it) or an
         # algorithm switch against the live register.
-        fresh = cur_fresh[seg_start_idx] | (s_algo != reg.algo)
+        fresh = reg_fresh | (s_algo != reg.algo)
         new_reg, resp = transition(reg, s_hits, s_limit, s_duration, s_algo, now, fresh)
         # One active lane per segment → scatter back is collision-free.
         widx = jnp.where(active, seg_start_idx, jnp.int32(B))
-        cur = _Reg(*jax.tree.map(
-            lambda c, n: c.at[widx].set(n, mode="drop"), cur, new_reg
-        ))
-        cur_fresh = cur_fresh.at[widx].set(False, mode="drop")
+        cur_packed = cur_packed.at[widx].set(
+            pack_reg(new_reg, jnp.zeros_like(fresh)), mode="drop")
         outs = WindowOutput(*jax.tree.map(
             lambda o, r: jnp.where(active, r, o), outs, resp
         ))
-        return p + 1, cur, cur_fresh, outs
+        return p + 1, cur_packed, outs
 
     def round_cond(carry):
         p = carry[0]
         return p <= max_pos
 
-    _, cur, _, outs = lax.while_loop(
-        round_cond, round_body, (jnp.int32(0), cur, cur_fresh, outs)
+    _, cur_packed, outs = lax.while_loop(
+        round_cond, round_body, (jnp.int32(0), cur_packed, outs)
     )
+    cur, _ = unpack_reg(cur_packed)
 
     # Uniform segments commit their closed-form state; replayed segments
     # commit the live register (one write per touched slot — the window's
