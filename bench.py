@@ -409,6 +409,7 @@ def bench_bigkeys(mesh, on_cpu, seconds=5.0):
     packed = np.zeros((1, 1, lanes, 2), np.int64)
     row = np.empty(lanes, np.int32)
     lane_arr = np.empty(lanes, np.int32)
+    pos_arr = np.empty(lanes, np.int32)
     l_ends = (np.arange(lanes, dtype=np.int64) + 1) * 8
     l_ones = np.ones(lanes, np.int64)
     l_lim = np.full(lanes, 1_000_000, np.int64)
@@ -430,7 +431,7 @@ def bench_bigkeys(mesh, on_cpu, seconds=5.0):
                 keys[b * 8:(b + step) * 8], l_ends[:step],
                 l_ones[:step], l_lim[:step], l_dur[:step], l_alg[:step],
                 now + i, lanes, 1, packed, kcur, fills,
-                row[b:b + step], lane_arr[b:b + step])
+                row[b:b + step], lane_arr[b:b + step], pos_arr[b:b + step])
             assert rc == step, rc
         words, _, _ = eng.pipeline_dispatch(
             packed, np.full(1, now + i, np.int64), n_windows=1)

@@ -164,8 +164,8 @@ class RpcJob:
     peer-plane lane (GetPeerRateLimits): the ring is ignored and everything
     is local, like the reference owner (gubernator.go:210-227)."""
 
-    __slots__ = ("data", "fut", "n", "row", "lane", "limit", "off", "mlen",
-                 "remote_idx", "forward_task", "peer_mode")
+    __slots__ = ("data", "fut", "n", "row", "lane", "pos", "limit", "off",
+                 "mlen", "remote_idx", "forward_task", "peer_mode")
 
     def __init__(self, data: bytes, fut: asyncio.Future,
                  peer_mode: bool = False):
@@ -175,6 +175,7 @@ class RpcJob:
         self.n = 0
         self.row = None
         self.lane = None
+        self.pos = None
         self.limit = None
         self.off = None
         self.mlen = None
@@ -186,7 +187,7 @@ class RpcJob:
             resp_buf = np.empty(self.n * 64 + 64, np.uint8)
             m = pipeline.engine.native.fastpath_encode_w(
                 wflat, self.limit, now, wflat.shape[-1], self.n,
-                self.row, self.lane, resp_buf, climit=clflat)
+                self.row, self.lane, self.pos, resp_buf, climit=clflat)
             return bytes(resp_buf[:m])
         # mixed RPC: encode the LOCAL items as framed per-item segments;
         # forwarded slots splice in later (_assemble_mixed)
@@ -195,7 +196,8 @@ class RpcJob:
         item_len = np.empty(self.n, np.int32)
         pipeline.engine.native.fastpath_encode_parts(
             wflat, self.limit, now, wflat.shape[-1], self.n,
-            self.row, self.lane, seg_buf, item_off, item_len, climit=clflat)
+            self.row, self.lane, self.pos, seg_buf, item_off, item_len,
+            climit=clflat)
         return bytes(seg_buf), item_off, item_len
 
 
@@ -204,7 +206,7 @@ class ListJob:
     packed columnar through the same stack.  Resolves each request's future
     (singles) or one future with the response list (batch)."""
 
-    __slots__ = ("reqs", "futs", "fut", "row", "lane", "n", "_cols")
+    __slots__ = ("reqs", "futs", "fut", "row", "lane", "pos", "n", "_cols")
 
     def __init__(self, reqs: Sequence[RateLimitReq],
                  futs: Optional[List[asyncio.Future]] = None,
@@ -215,6 +217,7 @@ class ListJob:
         self.n = len(self.reqs)
         self.row = None
         self.lane = None
+        self.pos = None
         self._cols = None
 
     def columns(self):
@@ -232,10 +235,25 @@ class ListJob:
 
     def finish(self, pipeline, wflat, clflat, now) -> List[RateLimitResp]:
         w = wflat[self.row, self.lane]
-        remaining = (w & 0x7FFFFFFF).tolist()
-        status = ((w >> 31) & 1).tolist()
         enc = (w >> 32) & 0xFFFFFFFF
-        reset = np.where(enc == 0, 0, now + enc - 1).tolist()
+        # aggregated/synthesizable items (pos >= 0, see host_router.cc
+        # decode_word_item): the word carries r_start; derive each item's
+        # response from its 0-based run position.  Plain items (pos == -1)
+        # decode the word directly.
+        pos = self.pos
+        synth = pos >= 0
+        p = np.where(synth, pos & 0x3FFFFFFF, 0)
+        algo1 = (pos >> 30) & 1
+        r_start = w & 0x7FFFFFFF
+        under = p < r_start
+        remaining = np.where(
+            synth, np.where(under, r_start - p - 1, 0),
+            w & 0x7FFFFFFF).tolist()
+        status = np.where(
+            synth, np.where(under, 0, 1), (w >> 31) & 1).tolist()
+        reset_plain = np.where(enc == 0, 0, now + enc - 1)
+        reset = np.where(
+            synth & (algo1 == 1) & under, 0, reset_plain).tolist()
         if clflat is not None:
             limits = clflat[self.row, self.lane].tolist()
         else:
@@ -644,13 +662,14 @@ class DispatchPipeline:
                     continue
                 job.row = np.empty(MAX_BATCH_SIZE, np.int32)
                 job.lane = np.empty(MAX_BATCH_SIZE, np.int32)
+                job.pos = np.empty(MAX_BATCH_SIZE, np.int32)
                 job.limit = np.empty(MAX_BATCH_SIZE, np.int64)
                 job.off = np.empty(MAX_BATCH_SIZE, np.int64)
                 job.mlen = np.empty(MAX_BATCH_SIZE, np.int32)
                 n = native.fastpath_parse_stack(
                     job.data, now, B, K, MAX_BATCH_SIZE, packed, kcur,
-                    fills, job.row, job.lane, job.limit, job.off, job.mlen,
-                    use_ring=not job.peer_mode)
+                    fills, job.row, job.lane, job.pos, job.limit, job.off,
+                    job.mlen, use_ring=not job.peer_mode)
                 if n >= 0:
                     job.n = n
                     job.remote_idx = np.flatnonzero(job.row[:n] < -1)
@@ -669,8 +688,9 @@ class DispatchPipeline:
                 cols = job.columns()
                 job.row = np.empty(job.n, np.int32)
                 job.lane = np.empty(job.n, np.int32)
+                job.pos = np.empty(job.n, np.int32)
                 rc = native.pack_stack(*cols, now, B, K, packed, kcur,
-                                       fills, job.row, job.lane)
+                                       fills, job.row, job.lane, job.pos)
                 if rc >= 0:
                     res.staged.append(job)
                     stack_empty = False

@@ -78,12 +78,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.fastpath_parse_stack.argtypes = [
             ctypes.c_void_p, u8p, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, ctypes.c_int32,
-            i64p, i32p, i32p, i32p, i32p, i64p, i64p, i32p,
+            i64p, i32p, i32p, i32p, i32p, i32p, i64p, i64p, i32p,
         ]
         lib.fastpath_encode_parts.restype = ctypes.c_int64
         lib.fastpath_encode_parts.argtypes = [
             i64p, i64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
-            i32p, i32p, i64p, u8p, ctypes.c_int64, i64p, i32p,
+            i32p, i32p, i32p, i64p, u8p, ctypes.c_int64, i64p, i32p,
         ]
         lib.router_set_ring.restype = None
         lib.router_set_ring.argtypes = [
@@ -94,12 +94,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.router_pack_stack.argtypes = [
             ctypes.c_void_p, u8p, i64p, ctypes.c_int64,
             i64p, i64p, i64p, i32p, ctypes.c_int64, ctypes.c_int32,
-            ctypes.c_int32, i64p, i32p, i32p, i32p, i32p,
+            ctypes.c_int32, i64p, i32p, i32p, i32p, i32p, i32p,
         ]
         lib.fastpath_encode_w.restype = ctypes.c_int64
         lib.fastpath_encode_w.argtypes = [
             i64p, i64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
-            i32p, i32p, i64p, u8p, ctypes.c_int64,
+            i32p, i32p, i32p, i64p, u8p, ctypes.c_int64,
         ]
         _lib = lib
         return _lib
@@ -218,6 +218,7 @@ class NativeRouter:
                              K: int, max_items: int, packed: np.ndarray,
                              kcur: np.ndarray, shard_fill: np.ndarray,
                              out_row: np.ndarray, out_lane: np.ndarray,
+                             out_pos: np.ndarray,
                              out_limit: np.ndarray, out_off: np.ndarray,
                              out_mlen: np.ndarray,
                              use_ring: bool = True) -> int:
@@ -236,6 +237,7 @@ class NativeRouter:
             _ptr(packed, ctypes.c_int64), _ptr(kcur, ctypes.c_int32),
             _ptr(shard_fill, ctypes.c_int32),
             _ptr(out_row, ctypes.c_int32), _ptr(out_lane, ctypes.c_int32),
+            _ptr(out_pos, ctypes.c_int32),
             _ptr(out_limit, ctypes.c_int64), _ptr(out_off, ctypes.c_int64),
             _ptr(out_mlen, ctypes.c_int32),
         )
@@ -243,6 +245,7 @@ class NativeRouter:
     def fastpath_encode_parts(self, w0: np.ndarray, item_limit: np.ndarray,
                               now: int, lanes: int, n: int,
                               out_row: np.ndarray, out_lane: np.ndarray,
+                              out_pos: np.ndarray,
                               resp_buf: np.ndarray, item_off: np.ndarray,
                               item_len: np.ndarray,
                               climit: Optional[np.ndarray] = None) -> int:
@@ -253,6 +256,7 @@ class NativeRouter:
             _ptr(w0, ctypes.c_int64), _ptr(item_limit, ctypes.c_int64),
             now, lanes, n,
             _ptr(out_row, ctypes.c_int32), _ptr(out_lane, ctypes.c_int32),
+            _ptr(out_pos, ctypes.c_int32),
             cl, _ptr(resp_buf, ctypes.c_uint8), resp_buf.nbytes,
             _ptr(item_off, ctypes.c_int64), _ptr(item_len, ctypes.c_int32),
         )
@@ -277,7 +281,8 @@ class NativeRouter:
                    durations: np.ndarray, algos: np.ndarray, now: int,
                    lanes: int, K: int, packed: np.ndarray,
                    kcur: np.ndarray, shard_fill: np.ndarray,
-                   out_row: np.ndarray, out_lane: np.ndarray) -> int:
+                   out_row: np.ndarray, out_lane: np.ndarray,
+                   out_pos: np.ndarray) -> int:
         """Columnar request list -> lanes staged across the K-window stack
         (same drain protocol as fastpath_parse_stack)."""
         return self._lib.router_pack_stack(
@@ -290,21 +295,24 @@ class NativeRouter:
             _ptr(packed, ctypes.c_int64), _ptr(kcur, ctypes.c_int32),
             _ptr(shard_fill, ctypes.c_int32),
             _ptr(out_row, ctypes.c_int32), _ptr(out_lane, ctypes.c_int32),
+            _ptr(out_pos, ctypes.c_int32),
         )
 
     def fastpath_encode_w(self, w0: np.ndarray, item_limit: np.ndarray,
                           now: int, lanes: int, n: int,
                           out_row: np.ndarray, out_lane: np.ndarray,
-                          resp_buf: np.ndarray,
+                          out_pos: np.ndarray, resp_buf: np.ndarray,
                           climit: Optional[np.ndarray] = None) -> int:
         """Fetched response-word plane -> serialized GetRateLimitsResp bytes
         (returns the length written into resp_buf).  climit: the device's
-        limit plane, passed only when a stored-limit mismatch was flagged."""
+        limit plane, passed only when a stored-limit mismatch was flagged.
+        out_pos: per-item synthesis info (aggregated runs), -1 = plain."""
         cl = _ptr(climit, ctypes.c_int64) if climit is not None else None
         m = self._lib.fastpath_encode_w(
             _ptr(w0, ctypes.c_int64), _ptr(item_limit, ctypes.c_int64),
             now, lanes, n,
             _ptr(out_row, ctypes.c_int32), _ptr(out_lane, ctypes.c_int32),
+            _ptr(out_pos, ctypes.c_int32),
             cl, _ptr(resp_buf, ctypes.c_uint8), resp_buf.nbytes,
         )
         if m < 0:

@@ -128,6 +128,13 @@ struct RepCell {
   int32_t algo;
   int32_t lanes;     // lanes staged for this key in its current window
   int32_t nonuniform;  // 1 once any lane broke the uniform pattern
+  // duplicate-run aggregation (stage-time, pass 2): while a key's run
+  // stays uniform hits=1/limit>0, later items fold into ONE staged lane
+  // (AGG_SLOT_BIT, kernel.py) instead of new lanes
+  int64_t agg_off;   // w0 index of the aggregation lane, -1 none
+  int32_t agg_k;     // window the lane lives in (stale => new lane)
+  int32_t agg_n;     // items folded so far (next item's 0-based pos)
+  int32_t slot;      // device slot of the lane (eviction check)
 };
 
 struct Router {
@@ -868,7 +875,7 @@ inline int rep_track(Router* r, int32_t shard, uint64_t fp, int64_t h,
       !(c->fp == fp && c->shard == shard)) {
     r->rep_live++;
     *c = RepCell{fp, h, l, d, r->drain_seq, shard, algo, 1,
-                 h == 0};
+                 h == 0, -1, -1, 0, -1};
     return 0;
   }
   c->lanes++;
@@ -877,7 +884,8 @@ inline int rep_track(Router* r, int32_t shard, uint64_t fp, int64_t h,
     c->nonuniform = 1;
   if (c->nonuniform && c->lanes > r->replay_cap) {
     // this lane starts the key's segment in a FRESH window
-    *c = RepCell{fp, h, l, d, r->drain_seq, shard, algo, 1, h == 0};
+    *c = RepCell{fp, h, l, d, r->drain_seq, shard, algo, 1, h == 0,
+                 -1, -1, 0, -1};
     return 1;
   }
   return 0;
@@ -899,28 +907,68 @@ bool stack_fits(const int64_t* demand, const int32_t* kcur,
 // i64[K, S, lanes, 2]; out_row gets the flattened window-row index
 // (widx * S + shard) so the encoder can address the fetched [K*S, lanes]
 // response plane directly.
+// AGG_SLOT_BIT mirror (ops/kernel.py): bit 30 of the packed slot+1 field
+// marks an aggregated hits=1 run; the device answers with r_start and the
+// encoder synthesizes each item's response from its 0-based position.
+constexpr int64_t AGG_W0_BIT = 1ll << 30;
+
 inline void stage_lane(Router* r, int32_t shard, uint64_t fp,
                        const uint8_t* key, int64_t key_len, int64_t now,
                        int64_t hits, int64_t limit, int64_t duration,
                        uint32_t algo, int32_t lanes, int32_t K,
                        int64_t* packed, int32_t* kcur, int32_t* shard_fill,
-                       int32_t* out_row, int32_t* out_lane, int64_t i,
-                       int force_new) {
+                       int32_t* out_row, int32_t* out_lane, int32_t* out_pos,
+                       int64_t i, int force_new) {
   int32_t S = r->num_shards;
   // replay-bound split (rep_track said so in pass 1): this lane opens a
   // fresh window for its shard so the device replay loop stays bounded
   if (force_new && shard_fill[kcur[shard] * S + shard] > 0) kcur[shard]++;
-  int32_t k = kcur[shard];
-  if (shard_fill[k * S + shard] >= lanes) k = ++kcur[shard];
-  int32_t lane = shard_fill[k * S + shard]++;
   uint8_t is_init = 0;
   int32_t slot = shard_lookup(&r->shards[shard], fp, now, duration,
                               r->pack_seq, &is_init, key, key_len);
+  bool synth = hits == 1 && limit > 0;  // response synthesizable by pos
+  // Probe the key's drain cell for BOTH synth and plain items: a plain
+  // lane staged for this key must invalidate any armed aggregation lane
+  // (folding a later item into a lane that sorts BEFORE the plain lane
+  // would reorder the key's sequential semantics — and pass-1 state
+  // cannot carry this, the replay-cap reset clears nonuniform).
+  RepCell* c = r->replay_cap ? rep_probe(r, shard, fp) : nullptr;
+  bool cell_live = c && c->seq == r->drain_seq && c->fp == (fp ? fp : 1) &&
+                   c->shard == shard;
+  if (synth && cell_live && !is_init && !c->nonuniform &&
+      c->agg_off >= 0 && c->agg_k == kcur[shard] && c->slot == slot &&
+      c->h == 1 && c->l == limit && c->d == duration &&
+      c->algo == (int32_t)algo) {
+    // fold into the existing aggregation lane: one more hit, no new lane
+    packed[c->agg_off] += 1ll << 34;
+    int64_t row_lane = c->agg_off / 2;
+    out_row[i] = (int32_t)(row_lane / lanes);
+    out_lane[i] = (int32_t)(row_lane % lanes);
+    out_pos[i] = c->agg_n++ | ((int32_t)algo << 30);
+    return;
+  }
+  int32_t k = kcur[shard];
+  if (shard_fill[k * S + shard] >= lanes) k = ++kcur[shard];
+  int32_t lane = shard_fill[k * S + shard]++;
   if (is_init) push_commit(r, shard, slot);
   int64_t row = (int64_t)k * S + shard;
   int64_t o = (row * lanes + lane) * 2;
-  packed[o] = (int64_t)(slot + 1) | ((int64_t)is_init << 32) |
-              ((int64_t)algo << 33) | (hits << 34);
+  int64_t w0 = (int64_t)(slot + 1) | ((int64_t)is_init << 32) |
+               ((int64_t)algo << 33) | (hits << 34);
+  if (synth) {
+    w0 |= AGG_W0_BIT;  // n=1 aggregate: device returns r_start
+    out_pos[i] = 0 | ((int32_t)algo << 30);
+    if (cell_live) {  // future uniform duplicates fold into this lane
+      c->agg_off = o;
+      c->agg_k = k;
+      c->agg_n = 1;
+      c->slot = slot;
+    }
+  } else {
+    out_pos[i] = -1;  // plain lane: legacy response decode
+    if (cell_live) c->agg_off = -1;  // see probe comment above
+  }
+  packed[o] = w0;
   packed[o + 1] = limit | (duration << 32);
   out_row[i] = (int32_t)row;
   out_lane[i] = lane;
@@ -993,6 +1041,7 @@ int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
                              int64_t* packed,
                              int32_t* kcur, int32_t* shard_fill,
                              int32_t* out_row, int32_t* out_lane,
+                             int32_t* out_pos,
                              int64_t* out_limit, int64_t* out_off,
                              int32_t* out_mlen) {
   int32_t S = r->num_shards;
@@ -1085,6 +1134,11 @@ int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
     }
     n++;
   }
+  // Demand counts every item as a lane even though uniform duplicates
+  // fold (pass 2 must never overflow, and fold prediction can break on
+  // mid-drain eviction/spill).  Conservative by up to the fold count —
+  // irrelevant at serving scale, where a FRESH stack's K*lanes dwarfs
+  // the 1000-item RPC cap.
   for (int32_t s = 0; s < S; s++)  // each split wastes < one window
     demand[s] += extra_windows[s] * lanes;
   if (!stack_fits(demand, kcur, shard_fill, S, lanes, K)) return -6;
@@ -1096,6 +1150,7 @@ int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
     if (it->owner >= 0) {  // forwarded item: marker + message byte range
       out_row[i] = -2 - it->owner;
       out_lane[i] = -1;
+      out_pos[i] = -1;
       out_limit[i] = it->limit;
       out_off[i] = it->msg_off;
       out_mlen[i] = it->msg_len;
@@ -1113,7 +1168,7 @@ int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
     }
     stage_lane(r, it->shard, it->fp, kb, kl, now, it->hits, it->limit,
                it->duration, it->algo, lanes, K, packed, kcur, shard_fill,
-               out_row, out_lane, i, bump[i]);
+               out_row, out_lane, out_pos, i, bump[i]);
     out_limit[i] = it->limit;
   }
   return n;
@@ -1132,7 +1187,7 @@ int64_t router_pack_stack(Router* r, const uint8_t* key_bytes,
                           int64_t now, int32_t lanes, int32_t K,
                           int64_t* packed, int32_t* kcur,
                           int32_t* shard_fill, int32_t* out_row,
-                          int32_t* out_lane) {
+                          int32_t* out_lane, int32_t* out_pos) {
   int32_t S = r->num_shards;
   if (S > MAX_STACK_SHARDS) return -2;
   if (n > MAX_STACK_ITEMS) return -3;
@@ -1169,8 +1224,8 @@ int64_t router_pack_stack(Router* r, const uint8_t* key_bytes,
     int64_t beg = i == 0 ? 0 : key_ends[i - 1];
     stage_lane(r, shards[i], fps[i], key_bytes + beg, key_ends[i] - beg,
                now, hits[i], limits[i], durations[i], (uint32_t)algos[i],
-               lanes, K, packed, kcur, shard_fill, out_row, out_lane, i,
-               bump2[i]);
+               lanes, K, packed, kcur, shard_fill, out_row, out_lane,
+               out_pos, i, bump2[i]);
   }
   return n;
 }
@@ -1184,9 +1239,34 @@ int64_t router_pack_stack(Router* r, const uint8_t* key_bytes,
 // device ships the full limit plane only when its per-window mismatch flag
 // fires, and `climit` is non-null only then.
 // Returns the byte length, or -1 if out_cap is too small.
+
+// Decode one response word for item i: aggregated/synthesizable items
+// (out_pos[i] >= 0: bits 0..29 the item's 0-based position in its run,
+// bit 30 the algorithm) synthesize from r_start; plain items read the
+// word directly.  See AGG_W0_BIT / ops/kernel.py transition(agg=...).
+inline void decode_word_item(int64_t word, int64_t now, int32_t posinfo,
+                             int64_t* status, int64_t* remaining,
+                             int64_t* reset) {
+  int64_t enc = (word >> 32) & 0xFFFFFFFFll;
+  if (posinfo >= 0) {
+    int64_t pos = posinfo & 0x3FFFFFFF;
+    int32_t algo = (posinfo >> 30) & 1;
+    int64_t r_start = word & 0x7FFFFFFFll;
+    bool under = pos < r_start;
+    *status = under ? 0 : 1;
+    *remaining = under ? r_start - pos - 1 : 0;
+    *reset = (enc == 0 || (algo == 1 && under)) ? 0 : now + enc - 1;
+  } else {
+    *status = (word >> 31) & 1;
+    *remaining = word & 0x7FFFFFFFll;
+    *reset = enc == 0 ? 0 : now + enc - 1;
+  }
+}
+
 int64_t fastpath_encode_w(const int64_t* w0, const int64_t* item_limit,
                           int64_t now, int32_t lanes, int64_t n,
                           const int32_t* out_row, const int32_t* out_lane,
+                          const int32_t* out_pos,
                           const int64_t* climit, uint8_t* out,
                           int64_t out_cap) {
   uint8_t* w = out;
@@ -1195,10 +1275,9 @@ int64_t fastpath_encode_w(const int64_t* w0, const int64_t* item_limit,
     int64_t o = (int64_t)out_row[i] * lanes + out_lane[i];
     int64_t word = w0[o];
     int64_t limit = climit ? climit[o] : item_limit[i];
-    int64_t remaining = word & 0x7FFFFFFFll;
-    int64_t status = (word >> 31) & 1;
-    int64_t enc = (word >> 32) & 0xFFFFFFFFll;
-    int64_t reset = enc == 0 ? 0 : now + enc - 1;
+    int64_t status, remaining, reset;
+    decode_word_item(word, now, out_pos ? out_pos[i] : -1,
+                     &status, &remaining, &reset);
 
     // RateLimitResp: status=1, limit=2, remaining=3, reset_time=4
     // (proto3: zero-valued fields are omitted)
@@ -1240,6 +1319,7 @@ int64_t fastpath_encode_w(const int64_t* w0, const int64_t* item_limit,
 int64_t fastpath_encode_parts(const int64_t* w0, const int64_t* item_limit,
                               int64_t now, int32_t lanes, int64_t n,
                               const int32_t* rows, const int32_t* lanes_arr,
+                              const int32_t* out_pos,
                               const int64_t* climit, uint8_t* out,
                               int64_t out_cap, int64_t* item_off,
                               int32_t* item_len) {
@@ -1254,10 +1334,9 @@ int64_t fastpath_encode_parts(const int64_t* w0, const int64_t* item_limit,
     int64_t o = (int64_t)rows[i] * lanes + lanes_arr[i];
     int64_t word = w0[o];
     int64_t limit = climit ? climit[o] : item_limit[i];
-    int64_t remaining = word & 0x7FFFFFFFll;
-    int64_t status = (word >> 31) & 1;
-    int64_t enc = (word >> 32) & 0xFFFFFFFFll;
-    int64_t reset = enc == 0 ? 0 : now + enc - 1;
+    int64_t status, remaining, reset;
+    decode_word_item(word, now, out_pos ? out_pos[i] : -1,
+                     &status, &remaining, &reset);
 
     int body = 0;
     if (status) body += 1 + varint_size((uint64_t)status);

@@ -66,6 +66,16 @@ OVER_LIMIT = 1
 # Slot value marking a padded (unused) lane of a window batch.
 PAD_SLOT = -1
 
+# Aggregated-run flag, carried in bit 30 of a lane's slot (arena capacities
+# are <= 2^27, so the bit is free; pads are negative and unaffected).  The
+# native router collapses a UNIFORM run of n identical hits=1, limit>0
+# requests to one key into ONE lane with hits=n and this bit set; the
+# device consumes k* = min(n, r_start) tokens and answers with r_start,
+# from which the host synthesizes every item's response (status_i =
+# i < r_start, remaining_i = max(r_start-(i+1), 0) — no n needed).  Only
+# the compact serving path ever sets it (host_router.cc).
+AGG_SLOT_BIT = 1 << 30
+
 I32 = jnp.int32
 I64 = jnp.int64
 
@@ -158,7 +168,8 @@ def _chain(pairs, default):
     return out
 
 
-def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh):
+def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh,
+               agg=None):
     """One request applied to one bucket, vectorized over the batch dimension.
 
     `fresh` marks lanes that must take the cache-miss/init path (new slot,
@@ -167,6 +178,12 @@ def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh):
     The branch ladders reproduce algorithms.go:24-85 (token) and
     algorithms.go:88-186 (leaky) exactly; see the module docstring for the
     three documented divergences.
+
+    `agg` (optional bool lanes) marks AGGREGATED runs (see AGG_SLOT_BIT):
+    the lane's `hits` carries the run length n of identical hits=1
+    requests, the state update consumes k* = min(n, r_start) exactly as n
+    sequential hits=1 transitions would, and the response's `remaining`
+    returns r_start (the pre-run balance) for host-side per-item synthesis.
     """
     L, D, R, T, E, A = reg
     h = hits
@@ -265,6 +282,54 @@ def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh):
 
     new_reg = jax.tree.map(lambda i, hh: jnp.where(fresh, i, hh), init_reg, hit_reg)
     out = jax.tree.map(lambda i, hh: jnp.where(fresh, i, hh), init_out, hit_out)
+    new_reg, out = _Reg(*new_reg), WindowOutput(*out)
+    if agg is None:
+        return new_reg, out
+
+    # ---- aggregated runs: n sequential hits=1 transitions in one lane ----
+    # r_start: post-init balance for fresh lanes (init consumes via k*, so
+    # the base is the full limit), else current balance with the leak
+    # applied for leaky.  limit > 0 guaranteed by the router's aggregation
+    # conditions (a fresh leaky limit=0 run's first item would need the
+    # init-path ResetTime=0 special the synthesis cannot express).
+    n = h
+    a_L = jnp.where(fresh, req_limit, L)
+    a_D = jnp.where(fresh, req_duration, D)
+    a_base_tok = jnp.where(fresh, req_limit, R)
+    a_base_lky = jnp.where(fresh, req_limit, R2)
+    a_base = jnp.where(is_token, a_base_tok, a_base_lky)
+    k = jnp.minimum(n, a_base)
+    a_R = a_base - k
+    a_rate = jnp.maximum(a_D // jnp.maximum(req_limit, ONE), ONE)
+    # leaky expiry: extends iff any GENERIC decrement happened (the last
+    # consume is a drain when the balance hits 0 — same accounting as
+    # uniform_closed_form)
+    lky_extended = (k - (a_R == 0)) >= 1
+    a_reg = _Reg(
+        limit=a_L,
+        duration=a_D,
+        remaining=a_R,
+        tstamp=jnp.where(is_token, jnp.where(fresh, now + req_duration, T),
+                         now),
+        expire=jnp.where(
+            is_token,
+            jnp.where(fresh, now + req_duration, E),
+            jnp.where(fresh | lky_extended, now + req_duration, E)),
+        algo=req_algo,
+    )
+    a_out = WindowOutput(
+        # host-synthesized per item; the word carries r_start and the
+        # OVER-item reset (token: the bucket's reset_time; leaky:
+        # now+rate — UNDER leaky items synthesize 0)
+        status=jnp.where(k < n, OVER_LIMIT, UNDER_LIMIT).astype(I32),
+        limit=a_L,
+        remaining=a_base,
+        reset_time=jnp.where(is_token,
+                             jnp.where(fresh, now + req_duration, T),
+                             now + a_rate),
+    )
+    new_reg = jax.tree.map(lambda a, b: jnp.where(agg, a, b), a_reg, new_reg)
+    out = jax.tree.map(lambda a, b: jnp.where(agg, a, b), a_out, out)
     return _Reg(*new_reg), WindowOutput(*out)
 
 
@@ -368,6 +433,7 @@ class WindowPrep(NamedTuple):
     seg_uniform: jax.Array
     max_pos: jax.Array
     commit_mask: jax.Array  # lanes whose register commits to the arena
+    s_agg: jax.Array   # aggregated-run lanes (AGG_SLOT_BIT), sorted order
 
 
 def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
@@ -388,24 +454,31 @@ def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
     C = state.limit.shape[0]
 
     valid = batch.slot >= 0
+    # Strip the aggregated-run flag off the slot BEFORE anything keys on
+    # slot values (sorting, sharding, the arena gather).
+    agg = valid & ((batch.slot & jnp.int32(AGG_SLOT_BIT)) != 0)
+    slot_clean = jnp.where(agg, batch.slot & jnp.int32(~AGG_SLOT_BIT),
+                           batch.slot)
     # Sort by slot (stable → arrival order preserved within a slot); pads last.
-    sort_key = jnp.where(valid, batch.slot, jnp.int32(2**31 - 1))
+    sort_key = jnp.where(valid, slot_clean, jnp.int32(2**31 - 1))
     order = jnp.argsort(sort_key)
     s_slot = sort_key[order]
     s_valid = valid[order]
-    # Permute the request fields as ONE packed [B, 5] row gather instead of
-    # five separate gathers: gather/scatter launches are a measured fixed
+    # Permute the request fields as ONE packed [B, 6] row gather instead of
+    # six separate gathers: gather/scatter launches are a measured fixed
     # cost per op on remote runtimes (BENCH_NOTES round 4), and the
     # pack/unpack is elementwise (fused, effectively free).
     packed_req = jnp.stack(
         [batch.hits, batch.limit, batch.duration,
-         batch.algo.astype(I64), batch.is_init.astype(I64)], axis=-1)
+         batch.algo.astype(I64), batch.is_init.astype(I64),
+         agg.astype(I64)], axis=-1)
     s_req = packed_req[order]
     s_hits = s_req[:, 0]
     s_limit = s_req[:, 1]
     s_duration = s_req[:, 2]
     s_algo = s_req[:, 3].astype(I32)
     s_init = s_req[:, 4].astype(jnp.bool_)
+    s_agg = s_req[:, 5].astype(jnp.bool_)
 
     idx = jnp.arange(B, dtype=I32)
     phys_start = jnp.concatenate(
@@ -463,7 +536,7 @@ def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
     fresh_seg = seg0[:, 4].astype(jnp.bool_)
     lane_ok = (
         (s_hits == h0) & (s_limit == l0) & (s_duration == d0)
-        & (s_algo == a0)
+        & (s_algo == a0) & ~s_agg
     )
     seg_ok = jnp.ones_like(s_algo).at[seg_start_idx].min(
         lane_ok.astype(I32), mode="drop")
@@ -473,7 +546,7 @@ def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
     return WindowPrep(order, s_slot, s_valid, s_hits, s_limit, s_duration,
                       s_algo, s_init, seg_start, seg_start_idx, pos,
                       seg_len, cur, fresh_seg, h0, l0, d0, a0, seg_uniform,
-                      max_pos, commit_mask)
+                      max_pos, commit_mask, s_agg)
 
 
 def window_commit(state: BucketState, prep: WindowPrep, fin: _Reg,
@@ -524,7 +597,7 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
     prep = window_prep(state, batch, now)
     (order, s_slot, s_valid, s_hits, s_limit, s_duration, s_algo, s_init,
      seg_start, seg_start_idx, pos, seg_len, cur, fresh_seg, h0, l0, d0,
-     a0, seg_uniform, max_pos, _commit_mask) = prep
+     a0, seg_uniform, max_pos, _commit_mask, s_agg) = prep
     cur_fresh = s_init | (cur.expire < now)
 
     # Registers travel PACKED as one [B, 7] row array (the seventh column
@@ -562,7 +635,8 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
         # is carried in the packed rows until its round clears it) or an
         # algorithm switch against the live register.
         fresh = reg_fresh | (s_algo != reg.algo)
-        new_reg, resp = transition(reg, s_hits, s_limit, s_duration, s_algo, now, fresh)
+        new_reg, resp = transition(reg, s_hits, s_limit, s_duration, s_algo,
+                                   now, fresh, agg=s_agg)
         # One active lane per segment → scatter back is collision-free.
         widx = jnp.where(active, seg_start_idx, jnp.int32(B))
         cur_packed = cur_packed.at[widx].set(

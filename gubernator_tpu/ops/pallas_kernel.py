@@ -119,8 +119,8 @@ def global_apply_pallas(state: BucketState, cfg: GlobalConfig,
 
 def _window_math_kernel(now_ref, maxpos_ref,
                         s_valid, s_hits, s_limit, s_duration, s_algo,
-                        s_init, pos, seg_len, seg_start_idx, seg_uniform,
-                        h0, l0, d0, a0, fresh_seg,
+                        s_init, s_agg, pos, seg_len, seg_start_idx,
+                        seg_uniform, h0, l0, d0, a0, fresh_seg,
                         r_lim, r_dur, r_rem, r_ts, r_exp, r_algo,
                         o_status, o_limit, o_rem, o_reset,
                         f_lim, f_dur, f_rem, f_ts, f_exp, f_algo):
@@ -160,7 +160,8 @@ def _window_math_kernel(now_ref, maxpos_ref,
         # it — no per-lane s_init term needed
         fresh = fr | (s_algo[:] != r.algo)
         new_r, resp = kernel.transition(
-            r, s_hits[:], s_limit[:], s_duration[:], s_algo[:], now, fresh)
+            r, s_hits[:], s_limit[:], s_duration[:], s_algo[:], now, fresh,
+            agg=s_agg[:])
         active = (p_arr == p) & valid & ~uniform
         # Propagate the active lane's result to its WHOLE segment (the
         # final commit reads registers at segment-start lanes, pos 0).
@@ -241,7 +242,7 @@ def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
     prep = kernel.window_prep(state, batch, now)
     (_, _, s_valid, s_hits, s_limit, s_duration, s_algo, s_init,
      _, seg_start_idx, pos, seg_len, cur, fresh_seg, h0, l0, d0, a0,
-     seg_uniform, max_pos, _commit_mask) = prep
+     seg_uniform, max_pos, _commit_mask, s_agg) = prep
 
     if compact32:
         lim = jnp.int64(2**31 - 16)
@@ -272,14 +273,14 @@ def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
     sspec = pl.BlockSpec((1,), lambda: (0,))
     outs = pl.pallas_call(
         _window_math_kernel,
-        in_specs=[sspec, sspec] + [spec] * 21,
+        in_specs=[sspec, sspec] + [spec] * 22,
         out_specs=[spec] * 10,
         out_shape=[sds(I32), sds(VD), sds(VD), sds(VD),   # outputs
                    sds(VD), sds(VD), sds(VD), sds(VD), sds(VD),
                    sds(I32)],                             # final regs
         interpret=interpret,
     )(k_now, max_pos.reshape((1,)),
-      s_valid, k_hits, k_limit, k_dur, s_algo, s_init,
+      s_valid, k_hits, k_limit, k_dur, s_algo, s_init, s_agg,
       pos, seg_len, seg_start_idx, seg_uniform,
       k_h0, k_l0, k_d0, a0, fresh_seg,
       k_cur.limit, k_cur.duration, k_cur.remaining, k_cur.tstamp,

@@ -64,6 +64,7 @@ rng = np.random.default_rng(13)
 packed = np.zeros((1, 1, lanes, 2), np.int64)
 row = np.empty(lanes, np.int32)
 lane_arr = np.empty(lanes, np.int32)
+pos_arr = np.empty(lanes, np.int32)
 l_ends = (np.arange(lanes, dtype=np.int64) + 1) * 8
 l_ones = np.ones(lanes, np.int64)
 l_lim = np.full(lanes, 1_000_000, np.int64)
@@ -87,7 +88,7 @@ for i in range(20):
             keys[b * 8:(b + step) * 8], l_ends[:step],
             l_ones[:step], l_lim[:step], l_dur[:step], l_alg[:step],
             now + i, lanes, 1, packed, kcur, fills,
-            row[b:b + step], lane_arr[b:b + step])
+            row[b:b + step], lane_arr[b:b + step], pos_arr[b:b + step])
         assert rc == step, rc
     t2 = time.perf_counter()
     words, _, _ = eng.pipeline_dispatch(

@@ -53,7 +53,8 @@ def _pack_run(eng, reqs, now, K=8, lanes=512):
         np.asarray([r.duration for r in reqs], np.int64),
         np.asarray([r.algorithm for r in reqs], np.int32),
         now, lanes, K, packed, kcur,
-        fills, np.empty(n, np.int32), np.empty(n, np.int32))
+        fills, np.empty(n, np.int32), np.empty(n, np.int32),
+        np.empty(n, np.int32))
     assert rc == n, rc
     nat.commit()
     return kcur, fills
@@ -80,8 +81,10 @@ def test_uniform_run_does_not_split():
                         duration=60_000) for _ in range(200)]
     s = shard_of(reqs[0].hash_key(), eng.num_shards)
     kcur, fills = _pack_run(eng, reqs, T0)
-    assert kcur[s] == 0          # single window
-    assert fills[0, s] == 200    # all lanes together (closed form is O(1))
+    assert kcur[s] == 0       # single window
+    # the whole uniform run AGGREGATES into one lane (AGG_SLOT_BIT):
+    # hot-key duplicates cost one device lane, not one each
+    assert fills[0, s] == 1
 
 
 def test_split_preserves_sequential_semantics():
