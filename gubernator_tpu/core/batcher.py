@@ -45,6 +45,10 @@ class WindowBatcher:
         self._executor = ThreadPoolExecutor(max_workers=1,
                                             thread_name_prefix="guber-device")
         self._closed = False
+        # Injectable clock for the classic (non-pipeline) window path —
+        # None means wall time.  Tests pin it alongside pipeline.now_fn so
+        # a job that falls back off the pipeline stays on the same clock.
+        self.now_fn = None
         # Mesh mode: windows dispatch on a fixed cluster-wide clock — every
         # tick, even empty, because all processes must issue the same
         # dispatch sequence (parallel/distributed.py).  submit_now loses its
@@ -70,10 +74,12 @@ class WindowBatcher:
     async def _legacy_process(self, reqs: Sequence[RateLimitReq]
                               ) -> List[RateLimitResp]:
         """Full-path processing for pipeline fallbacks (chunking, full wire
-        format, every semantic)."""
+        format, every semantic).  Honors the injectable clock (now_fn) so
+        tests keep fallbacks on the same timeline as pipeline drains."""
         loop = asyncio.get_running_loop()
+        now = self.now_fn() if self.now_fn is not None else None
         return await loop.run_in_executor(
-            self._executor, lambda: self.engine.process(reqs))
+            self._executor, lambda: self.engine.process(reqs, now))
 
     async def submit_rpc(self, data: bytes, peer_mode: bool = False):
         """Serve a whole serialized GetRateLimitsReq (or, with peer_mode,
@@ -255,8 +261,10 @@ class WindowBatcher:
         loop = asyncio.get_running_loop()
         start = time.monotonic()
         try:
+            now = self.now_fn() if self.now_fn is not None else None
             resps = await loop.run_in_executor(
-                self._executor, lambda: self.engine.process(reqs, None, accumulate)
+                self._executor,
+                lambda: self.engine.process(reqs, now, accumulate)
             )
         except Exception as e:  # resolve every waiter with the failure
             for _, _, fut in window:

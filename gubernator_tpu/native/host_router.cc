@@ -130,11 +130,19 @@ struct RepCell {
   int32_t nonuniform;  // 1 once any lane broke the uniform pattern
   // duplicate-run aggregation (stage-time, pass 2): while a key's run
   // stays uniform hits=1/limit>0, later items fold into ONE staged lane
-  // (AGG_SLOT_BIT, kernel.py) instead of new lanes
+  // (AGG_SLOT_BIT, kernel.py) instead of new lanes.  The fold compares
+  // against the ARMED LANE's own tuple (agg_l/agg_d/agg_algo) — the
+  // pass-1 cfg above is tracking state that the replay-cap reset
+  // rewrites and MUST NOT gate folding (fuzz-caught: a stale-reset cfg
+  // matched a later item into a different-config lane).  Every staged
+  // lane of a key re-arms or invalidates the target, so the armed lane
+  // is always the key's LATEST lane and folding never reorders.
   int64_t agg_off;   // w0 index of the aggregation lane, -1 none
   int32_t agg_k;     // window the lane lives in (stale => new lane)
   int32_t agg_n;     // items folded so far (next item's 0-based pos)
   int32_t slot;      // device slot of the lane (eviction check)
+  int64_t agg_l, agg_d;  // the armed lane's limit/duration (hits == 1)
+  int32_t agg_algo;
 };
 
 struct Router {
@@ -875,7 +883,7 @@ inline int rep_track(Router* r, int32_t shard, uint64_t fp, int64_t h,
       !(c->fp == fp && c->shard == shard)) {
     r->rep_live++;
     *c = RepCell{fp, h, l, d, r->drain_seq, shard, algo, 1,
-                 h == 0, -1, -1, 0, -1};
+                 h == 0, -1, -1, 0, -1, 0, 0, 0};
     return 0;
   }
   c->lanes++;
@@ -885,10 +893,43 @@ inline int rep_track(Router* r, int32_t shard, uint64_t fp, int64_t h,
   if (c->nonuniform && c->lanes > r->replay_cap) {
     // this lane starts the key's segment in a FRESH window
     *c = RepCell{fp, h, l, d, r->drain_seq, shard, algo, 1, h == 0,
-                 -1, -1, 0, -1};
+                 -1, -1, 0, -1, 0, 0, 0};
     return 1;
   }
   return 0;
+}
+
+// Exact pass-1 placement check: walk the staged items per shard in order
+// (fold-predicted items still count a lane — conservative; fold
+// misprediction must never overflow pass 2), applying window spills and
+// replay-cap splits exactly as stage_lane will.  items: per-item shard;
+// bumps: per-item force-new flags.  Returns false if any shard would run
+// past the K-th window.
+bool stack_fits_exact(const int32_t* shards_arr, const uint8_t* bumps,
+                      int64_t n, const int32_t* kcur,
+                      const int32_t* shard_fill, int32_t S, int32_t lanes,
+                      int32_t K) {
+  int32_t simk[MAX_STACK_SHARDS];
+  int32_t simfill[MAX_STACK_SHARDS];
+  for (int32_t s = 0; s < S; s++) {
+    simk[s] = kcur[s];
+    simfill[s] = shard_fill[kcur[s] * S + s];
+  }
+  for (int64_t i = 0; i < n; i++) {
+    int32_t s = shards_arr[i];
+    if (s < 0) continue;  // forwarded / not staged
+    if (bumps[i] && simfill[s] > 0) {
+      simk[s]++;
+      simfill[s] = 0;
+    }
+    if (simfill[s] >= lanes) {
+      simk[s]++;
+      simfill[s] = 0;
+    }
+    if (simk[s] >= K) return false;
+    simfill[s]++;
+  }
+  return true;
 }
 
 bool stack_fits(const int64_t* demand, const int32_t* kcur,
@@ -935,10 +976,10 @@ inline void stage_lane(Router* r, int32_t shard, uint64_t fp,
   RepCell* c = r->replay_cap ? rep_probe(r, shard, fp) : nullptr;
   bool cell_live = c && c->seq == r->drain_seq && c->fp == (fp ? fp : 1) &&
                    c->shard == shard;
-  if (synth && cell_live && !is_init && !c->nonuniform &&
+  if (synth && cell_live && !is_init &&
       c->agg_off >= 0 && c->agg_k == kcur[shard] && c->slot == slot &&
-      c->h == 1 && c->l == limit && c->d == duration &&
-      c->algo == (int32_t)algo) {
+      c->agg_l == limit && c->agg_d == duration &&
+      c->agg_algo == (int32_t)algo) {
     // fold into the existing aggregation lane: one more hit, no new lane
     packed[c->agg_off] += 1ll << 34;
     int64_t row_lane = c->agg_off / 2;
@@ -963,6 +1004,9 @@ inline void stage_lane(Router* r, int32_t shard, uint64_t fp,
       c->agg_k = k;
       c->agg_n = 1;
       c->slot = slot;
+      c->agg_l = limit;
+      c->agg_d = duration;
+      c->agg_algo = (int32_t)algo;
     }
   } else {
     out_pos[i] = -1;  // plain lane: legacy response decode
@@ -1049,8 +1093,7 @@ int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
   if (max_items > MAX_STACK_ITEMS) max_items = MAX_STACK_ITEMS;
   static thread_local ParsedItem items[MAX_STACK_ITEMS];
   static thread_local uint8_t bump[MAX_STACK_ITEMS];
-  int64_t demand[MAX_STACK_SHARDS] = {0};
-  int64_t extra_windows[MAX_STACK_SHARDS] = {0};
+  static thread_local int32_t item_shard[MAX_STACK_ITEMS];
 
   // ---- pass 1: parse + validate + hash, no side effects on the router
   //      tables (the replay-bound tracker is drain-scoped and purely
@@ -1109,6 +1152,7 @@ int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
       if (owner != r->ring_self) {
         it->owner = owner;  // forwarded: parsed but never staged
         bump[n] = 0;
+        item_shard[n] = -1;
         n++;
         continue;
       }
@@ -1124,24 +1168,21 @@ int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
                     r->shard_offset;
     if (shard < 0 || shard >= S) return -2;  // not ours: full path routes it
     it->shard = shard;
-    demand[shard]++;
+    item_shard[n] = shard;
     bump[n] = (uint8_t)rep_track(r, shard, it->fp, it->hits, it->limit,
                                  it->duration, (int32_t)it->algo);
-    extra_windows[shard] += bump[n];
     if (r->exact) {
       it->scratch_off = scratch_need;
       scratch_need += it->name_len + 1 + it->key_len;
     }
     n++;
   }
-  // Demand counts every item as a lane even though uniform duplicates
-  // fold (pass 2 must never overflow, and fold prediction can break on
-  // mid-drain eviction/spill).  Conservative by up to the fold count —
-  // irrelevant at serving scale, where a FRESH stack's K*lanes dwarfs
-  // the 1000-item RPC cap.
-  for (int32_t s = 0; s < S; s++)  // each split wastes < one window
-    demand[s] += extra_windows[s] * lanes;
-  if (!stack_fits(demand, kcur, shard_fill, S, lanes, K)) return -6;
+  // Exact placement simulation: spills and replay-cap splits are applied
+  // as pass 2 will; fold-predicted duplicates still count a lane
+  // (conservative — fold prediction can break on mid-drain eviction, and
+  // pass 2 must never overflow).
+  if (!stack_fits_exact(item_shard, bump, n, kcur, shard_fill, S, lanes, K))
+    return -6;
 
   // ---- pass 2: stage (cannot fail) ----
   uint8_t* scratch = r->exact ? scratch_reserve(r, scratch_need) : nullptr;
@@ -1194,8 +1235,6 @@ int64_t router_pack_stack(Router* r, const uint8_t* key_bytes,
   static thread_local uint64_t fps[MAX_STACK_ITEMS];
   static thread_local int32_t shards[MAX_STACK_ITEMS];
   static thread_local uint8_t bump2[MAX_STACK_ITEMS];
-  int64_t demand[MAX_STACK_SHARDS] = {0};
-  int64_t extra_windows[MAX_STACK_SHARDS] = {0};
 
   for (int64_t i = 0; i < n; i++) {
     if (hits[i] < 0 || hits[i] >= COMPACT_MAX_HITS) return -2;
@@ -1211,14 +1250,11 @@ int64_t router_pack_stack(Router* r, const uint8_t* key_bytes,
     if (shard < 0 || shard >= S) return -5;
     shards[i] = shard;
     fps[i] = fnv1a64(key, len);
-    demand[shard]++;
     bump2[i] = (uint8_t)rep_track(r, shard, fps[i], hits[i], limits[i],
                                   durations[i], algos[i]);
-    extra_windows[shard] += bump2[i];
   }
-  for (int32_t s = 0; s < S; s++)  // each split wastes < one window
-    demand[s] += extra_windows[s] * lanes;
-  if (!stack_fits(demand, kcur, shard_fill, S, lanes, K)) return -6;
+  if (!stack_fits_exact(shards, bump2, n, kcur, shard_fill, S, lanes, K))
+    return -6;
 
   for (int64_t i = 0; i < n; i++) {
     int64_t beg = i == 0 ? 0 : key_ends[i - 1];

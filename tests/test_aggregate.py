@@ -244,3 +244,60 @@ def test_plain_lane_invalidates_aggregation_target():
         for j, (g, w) in enumerate(zip(got, want)):
             assert (int(g.status), g.remaining) == \
                 (int(w.status), w.remaining), (cap, j)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_pipeline_fuzz_differential(seed):
+    """Randomized multi-drain differential through the aggregating
+    pipeline: Zipf-hot keys, mostly hits=1 (the aggregable shape) mixed
+    with reads/bursts, both algorithms, a small arena (eviction pressure)
+    and a tiny replay cap (window splits + pass-1 resets) — every
+    response must equal the plain Python engine's, lane for lane."""
+    import asyncio
+
+    from gubernator_tpu import native
+    from gubernator_tpu.api.types import RateLimitReq
+    from gubernator_tpu.config import BehaviorConfig
+    from gubernator_tpu.core.batcher import WindowBatcher
+    from gubernator_tpu.core.engine import RateLimitEngine
+
+    if not native.available():
+        pytest.skip("native router unavailable")
+
+    rng = np.random.default_rng(100 + seed)
+    eng = RateLimitEngine(capacity_per_shard=64, batch_per_shard=32,
+                          global_capacity=16, global_batch_per_shard=8,
+                          max_global_updates=8, use_native="on")
+    ref = RateLimitEngine(capacity_per_shard=64, batch_per_shard=32,
+                          global_capacity=16, global_batch_per_shard=8,
+                          max_global_updates=8, use_native=False)
+    eng.native.set_replay_cap(4)
+
+    now = T0
+    for drain in range(6):
+        now += int(rng.integers(0, 40_000))  # cross expiry boundaries
+        b = WindowBatcher(eng, BehaviorConfig())
+        assert b.pipeline is not None and b.pipeline.enabled
+        t = now
+        b.pipeline.now_fn = lambda t=t: t
+        b.now_fn = b.pipeline.now_fn  # keep any fallback on the same clock
+        reqs = []
+        for _ in range(60):
+            key = f"z{(rng.zipf(1.3) - 1) % 7}"
+            hits = int(rng.choice([1, 1, 1, 1, 0, 2]))
+            lim = int(rng.choice([5, 5, 9]))
+            reqs.append(RateLimitReq(
+                name="fz", unique_key=key, hits=hits, limit=lim,
+                duration=int(rng.choice([1_000, 30_000])),
+                algorithm=int(rng.integers(0, 2))))
+
+        async def run():
+            return await asyncio.gather(*(b.submit(r) for r in reqs))
+
+        got = asyncio.run(run())
+        b.close()
+        want = ref.process(reqs, now=now)
+        for j, (g, w) in enumerate(zip(got, want)):
+            assert (int(g.status), g.limit, g.remaining, g.reset_time) == \
+                (int(w.status), w.limit, w.remaining, w.reset_time), \
+                (seed, drain, j, reqs[j])
