@@ -289,7 +289,7 @@ def bench_host_pipeline(mesh, capacity, lanes, seconds=5.0, concurrency=128):
         # sync/e2e tiers still produce their numbers
         log("# host tier (pipelined): native router unavailable; skipped")
         batcher.close()
-        return 0.0
+        return 0.0, 1.0
     N = 1000
     payloads = _zipf_payloads(pb, 16, N, 100_000, "host")
 
@@ -321,10 +321,14 @@ def bench_host_pipeline(mesh, capacity, lanes, seconds=5.0, concurrency=128):
     per_sec = asyncio.run(run())
     if prof_dir:
         jax.profiler.stop_trace()
+    pipe = batcher.pipeline
+    fold = (pipe.decisions_staged / pipe.lanes_staged
+            if pipe.lanes_staged else 1.0)
     batcher.close()
     log(f"# host tier (pipelined): {per_sec:,.0f} decisions/sec "
-        f"({concurrency} x {N}-item RPC streams)")
-    return per_sec
+        f"({concurrency} x {N}-item RPC streams, "
+        f"aggregation fold {fold:.2f}x)")
+    return per_sec, fold
 
 
 def bench_host_sync(mesh, capacity, lanes, seconds=3.0):
@@ -700,10 +704,11 @@ def child_main():
         result["window_p99_ms"] = round(p99_ms, 3)
         checkpoint()
 
-        host_ps = bench_host_pipeline(mesh, capacity, lanes,
-                                      seconds=3.0 if on_cpu else 5.0,
-                                      concurrency=32 if on_cpu else 256)
+        host_ps, fold = bench_host_pipeline(
+            mesh, capacity, lanes, seconds=3.0 if on_cpu else 5.0,
+            concurrency=32 if on_cpu else 256)
         result["host_decisions_per_sec"] = round(host_ps, 1)
+        result["aggregation_fold"] = round(fold, 2)
         checkpoint()
 
         sync_ps = bench_host_sync(mesh, capacity, lanes,

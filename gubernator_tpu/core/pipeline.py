@@ -339,6 +339,10 @@ class DispatchPipeline:
         # observability: RPCs fully served by this lane (tests assert the
         # lane actually engaged rather than silently falling back)
         self.rpc_served = 0
+        # duplicate-run aggregation telemetry (engine-thread only):
+        # decisions_staged / lanes_staged = the fold factor
+        self.decisions_staged = 0
+        self.lanes_staged = 0
         # strong refs to every in-flight delivery-path task (the loop keeps
         # only weak ones; a GC'd task would hang the futures it owes)
         self._tasks: set = set()
@@ -732,6 +736,10 @@ class DispatchPipeline:
         # engine.process increments the same attribute from this thread,
         # so updating it from the event loop would race (lost updates)
         eng.decisions_processed += res.n_decisions
+        # duplicate-run aggregation observability: decisions vs lanes
+        # actually staged — the fold factor a bench can report
+        self.decisions_staged += res.n_decisions
+        self.lanes_staged += int(fills.sum())
         return res
 
     # ------------------------------------------------------------ fetch side
