@@ -267,7 +267,8 @@ class ListJob:
 
 class _DrainResult:
     __slots__ = ("words", "limits", "mism", "staged", "fallback", "leftover",
-                 "now", "n_decisions", "error", "started", "ring_peers")
+                 "now", "n_decisions", "n_lanes", "error", "started",
+                 "ring_peers")
 
     def __init__(self):
         self.words = None
@@ -278,6 +279,7 @@ class _DrainResult:
         self.leftover = []
         self.now = 0
         self.n_decisions = 0
+        self.n_lanes = 0
         self.error = None
         self.started = 0.0
         self.ring_peers = ()
@@ -572,6 +574,8 @@ class DispatchPipeline:
             self.metrics.window_occupancy.observe(res.n_decisions)
             self.metrics.window_duration.observe(
                 time.monotonic() - res.started)
+            self.metrics.agg_decisions.inc(res.n_decisions)
+            self.metrics.agg_lanes.inc(res.n_lanes)
         self._pump()
 
     async def _assemble_mixed(self, job: RpcJob, local_parts, now) -> None:
@@ -738,8 +742,9 @@ class DispatchPipeline:
         eng.decisions_processed += res.n_decisions
         # duplicate-run aggregation observability: decisions vs lanes
         # actually staged — the fold factor a bench can report
+        res.n_lanes = int(fills.sum())
         self.decisions_staged += res.n_decisions
-        self.lanes_staged += int(fills.sum())
+        self.lanes_staged += res.n_lanes
         return res
 
     # ------------------------------------------------------------ fetch side

@@ -77,6 +77,18 @@ class Metrics:
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000),
             registry=self.registry,
         )
+        # duplicate-run aggregation: decisions served vs lanes staged —
+        # rate(decisions)/rate(lanes) is the live fold factor
+        self.agg_decisions = Counter(
+            "guber_tpu_aggregation_decisions_total",
+            "Decisions served by the pipelined drain.",
+            registry=self.registry,
+        )
+        self.agg_lanes = Counter(
+            "guber_tpu_aggregation_lanes_total",
+            "Device lanes staged by the pipelined drain.",
+            registry=self.registry,
+        )
         self.window_duration = Histogram(
             "guber_tpu_window_duration_seconds",
             "Wall time of one device window step.",
