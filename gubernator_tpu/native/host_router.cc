@@ -932,18 +932,6 @@ bool stack_fits_exact(const int32_t* shards_arr, const uint8_t* bumps,
   return true;
 }
 
-bool stack_fits(const int64_t* demand, const int32_t* kcur,
-                const int32_t* shard_fill, int32_t S, int32_t lanes,
-                int32_t K) {
-  for (int32_t s = 0; s < S; s++) {
-    if (!demand[s]) continue;
-    int64_t free_lanes = (int64_t)(lanes - shard_fill[kcur[s] * S + s]) +
-                         (int64_t)(K - 1 - kcur[s]) * lanes;
-    if (demand[s] > free_lanes) return false;
-  }
-  return true;
-}
-
 // Stage one resolved item into the window stack.  packed is
 // i64[K, S, lanes, 2]; out_row gets the flattened window-row index
 // (widx * S + shard) so the encoder can address the fetched [K*S, lanes]
