@@ -52,6 +52,12 @@ import time
 BASELINE_REQS_PER_SEC = 2000.0
 CHILD_ENV = "GUBER_BENCH_CHILD"
 OUT_ENV = "GUBER_BENCH_OUT"
+# Durable record of the newest real-TPU tier numbers, updated at every
+# tier checkpoint of a TPU-backed run.  When the tunnel is wedged at
+# driver time (round-4: BENCH_r04.json recorded 0.0) the fallback path
+# reports these, tagged stale, instead of a bare zero.
+TPU_CHECKPOINT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_TPU_CHECKPOINT.json")
 
 
 def log(msg):
@@ -121,13 +127,20 @@ def acquire_backend(attempts=5, probe_timeout=75.0):
         "if plat: jax.config.update('jax_platforms', plat)\n"
         "jax.block_until_ready(jax.numpy.zeros((8,)) + 1)\n"
         "print('PROBE_OK', jax.devices()[0].platform)\n")
+    if os.environ.get("GUBER_BENCH_SIMULATE_WEDGE") and plat != "cpu":
+        # test hook for the fallback path: behave as if every TPU probe hung
+        raise RuntimeError("TPU backend unavailable (simulated wedge)")
     last = "probe never ran"
     for i in range(attempts):
         t0 = time.time()
         try:
+            # a wedged tunnel stays wedged — after the first full-length
+            # probe, shorter ones conserve the wall budget for the CPU
+            # fallback tiers (killing the probe is itself the recovery nudge)
+            this_timeout = probe_timeout if i == 0 else min(probe_timeout, 30)
             proc = subprocess.run(
                 [sys.executable, "-c", probe_code],
-                timeout=probe_timeout, capture_output=True)
+                timeout=this_timeout, capture_output=True)
             if proc.returncode == 0 and b"PROBE_OK" in proc.stdout:
                 import jax
 
@@ -139,7 +152,7 @@ def acquire_backend(attempts=5, probe_timeout=75.0):
             last = (proc.stderr or proc.stdout)[-300:].decode(
                 errors="replace")
         except subprocess.TimeoutExpired:
-            last = f"probe hung >{probe_timeout:.0f}s (tunnel wedged?)"
+            last = f"probe hung >{this_timeout:.0f}s (tunnel wedged?)"
         except Exception as e:  # noqa: BLE001 — deliberately broad: retry
             last = f"{type(e).__name__}: {e}"
         log(f"# backend attempt {i + 1}/{attempts} failed after "
@@ -652,6 +665,15 @@ def bench_pallas_probe(on_cpu):
                 "pallas_error": f"{type(e).__name__}: {str(e)[:200]}"}
 
 
+def _load_tpu_checkpoint():
+    try:
+        with open(TPU_CHECKPOINT) as f:
+            data = json.loads(f.read())
+        return data if data.get("value") else None
+    except Exception:  # noqa: BLE001 — absent/corrupt checkpoint = no stale
+        return None
+
+
 def child_main():
     result = {}
 
@@ -660,14 +682,69 @@ def child_main():
         not cost the numbers already captured (the parent kills the child
         at the wall budget and reads whatever was last written).  Atomic
         via rename — a SIGKILL mid-write must not truncate the last good
-        checkpoint."""
+        checkpoint.  Real-TPU runs ALSO update the durable repo-level
+        checkpoint so a later wedged-tunnel run can report stale truth
+        instead of 0.0."""
         tmp = os.environ[OUT_ENV] + ".tmp"
         with open(tmp, "w") as f:
             f.write(json.dumps(result))
         os.replace(tmp, os.environ[OUT_ENV])
+        if result.get("backend") not in (None, "cpu", "cpu-fallback"):
+            # MERGE into the previous durable record (a pre-e2e checkpoint
+            # must not clobber the last good headline with a value-less
+            # snapshot — the value key is what the wedged-run fallback
+            # reports)
+            try:
+                with open(TPU_CHECKPOINT) as f:
+                    snap = json.loads(f.read())
+            except Exception:  # noqa: BLE001
+                snap = {}
+            snap.update(result)
+            snap["measured_at"] = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+            if "e2e_decisions_per_sec" in result:
+                snap["value"] = result["e2e_decisions_per_sec"]
+                snap["vs_baseline"] = round(
+                    snap["value"] / BASELINE_REQS_PER_SEC, 2)
+                snap["value_measured_at"] = snap["measured_at"]
+            if not snap.get("value"):
+                return  # never persist a headline-less durable record
+            try:
+                with open(TPU_CHECKPOINT + ".tmp", "w") as f:
+                    f.write(json.dumps(snap))
+                os.replace(TPU_CHECKPOINT + ".tmp", TPU_CHECKPOINT)
+            except OSError:
+                pass
 
+    tunnel_error = None
     try:
-        devs = acquire_backend()
+        try:
+            devs = acquire_backend()
+        except RuntimeError as e:
+            # tunnel wedged: fall back to CPU smoke tiers so the round
+            # record carries real measurements, not a bare 0.0.  Tag the
+            # record and merge the stale TPU headline IMMEDIATELY so a
+            # wall-budget kill mid-tier still publishes an honest,
+            # fully-labelled checkpoint (review finding: late tagging
+            # made a killed fallback run look like a deliberate CPU run).
+            tunnel_error = str(e)
+            log(f"# TPU unavailable ({tunnel_error}); falling back to "
+                f"CPU smoke tiers")
+            result["backend"] = "cpu-fallback"
+            result["tunnel_error"] = tunnel_error
+            stale = _load_tpu_checkpoint()
+            if stale:
+                for k, v in stale.items():
+                    if k not in ("backend", "error", "tunnel_error"):
+                        result.setdefault(k, v)
+                result["value"] = stale["value"]
+                result["vs_baseline"] = stale.get("vs_baseline", round(
+                    stale["value"] / BASELINE_REQS_PER_SEC, 2))
+                result["stale"] = True
+                result["stale_measured_at"] = stale.get(
+                    "measured_at", "unknown")
+            os.environ["GUBER_BENCH_PLATFORM"] = "cpu"
+            devs = acquire_backend(attempts=2, probe_timeout=180.0)
         import jax
         import jax.numpy as jnp
 
@@ -687,7 +764,10 @@ def child_main():
 
         dev = devs[0]
         log(f"# backend: {dev.platform} ({dev.device_kind})")
-        result["backend"] = dev.platform
+        # fallback mode: tier numbers nest under cpu_smoke, the top level
+        # keeps the stale-TPU headline set above
+        tier = result.setdefault("cpu_smoke", {}) if tunnel_error else result
+        tier["backend"] = dev.platform
 
         # CPU backend (local smoke runs) gets small shapes; the driver's
         # real-TPU run gets the full production shapes
@@ -699,46 +779,54 @@ def child_main():
 
         dev_ps, p50_ms, p99_ms = bench_device(kernel, jax, jnp, mesh,
                                               capacity, lanes, iters)
-        result["device_decisions_per_sec"] = round(dev_ps, 1)
-        result["window_p50_ms"] = round(p50_ms, 3)
-        result["window_p99_ms"] = round(p99_ms, 3)
+        tier["device_decisions_per_sec"] = round(dev_ps, 1)
+        tier["window_p50_ms"] = round(p50_ms, 3)
+        tier["window_p99_ms"] = round(p99_ms, 3)
         checkpoint()
 
         host_ps, fold = bench_host_pipeline(
             mesh, capacity, lanes, seconds=3.0 if on_cpu else 5.0,
             concurrency=32 if on_cpu else 256)
-        result["host_decisions_per_sec"] = round(host_ps, 1)
-        result["aggregation_fold"] = round(fold, 2)
+        tier["host_decisions_per_sec"] = round(host_ps, 1)
+        tier["aggregation_fold"] = round(fold, 2)
         checkpoint()
 
         sync_ps = bench_host_sync(mesh, capacity, lanes,
                                   seconds=2.0 if on_cpu else 3.0)
-        result["host_sync_decisions_per_sec"] = round(sync_ps, 1)
+        tier["host_sync_decisions_per_sec"] = round(sync_ps, 1)
         checkpoint()
 
         e2e_ps, ping_p50, herd_rps, herd_p99 = bench_e2e(
             mesh, capacity, lanes, seconds=3.0 if on_cpu else 5.0,
             concurrency=8 if on_cpu else 32)
-        result["e2e_decisions_per_sec"] = round(e2e_ps, 1)
-        result["healthcheck_rtt_ms_p50"] = round(ping_p50, 3)
-        result["thundering_herd_rps"] = round(herd_rps, 1)
-        result["thundering_herd_p99_ms"] = round(herd_p99, 2)
+        tier["e2e_decisions_per_sec"] = round(e2e_ps, 1)
+        tier["healthcheck_rtt_ms_p50"] = round(ping_p50, 3)
+        tier["thundering_herd_rps"] = round(herd_rps, 1)
+        tier["thundering_herd_p99_ms"] = round(herd_p99, 2)
 
         # headline locked in BEFORE the bigkeys tier: a failure allocating
         # the 2^27 arena must not zero a measured e2e number
-        result["value"] = round(e2e_ps, 1)
-        result["vs_baseline"] = round(e2e_ps / BASELINE_REQS_PER_SEC, 2)
+        tier["value"] = round(e2e_ps, 1)
+        tier["vs_baseline"] = round(e2e_ps / BASELINE_REQS_PER_SEC, 2)
         checkpoint()
 
-        result.update(bench_bigkeys(mesh, on_cpu,
-                                    seconds=3.0 if on_cpu else 5.0))
+        tier.update(bench_bigkeys(mesh, on_cpu,
+                                  seconds=3.0 if on_cpu else 5.0))
         checkpoint()
 
-        result.update(bench_pallas_probe(on_cpu))
+        tier.update(bench_pallas_probe(on_cpu))
     except Exception as e:  # noqa: BLE001 — the parent still prints JSON
         import traceback
         traceback.print_exc()
         result["error"] = f"{type(e).__name__}: {e}"
+    if tunnel_error and not result.get("stale"):
+        # no durable TPU record existed: headline = CPU smoke e2e,
+        # clearly labelled (backend/tunnel_error were tagged up front)
+        cpu_e2e = result.get("cpu_smoke", {}).get("e2e_decisions_per_sec")
+        if cpu_e2e:
+            result["value"] = cpu_e2e
+            result["vs_baseline"] = round(cpu_e2e / BASELINE_REQS_PER_SEC, 2)
+            result["stale"] = False
     checkpoint()
 
 

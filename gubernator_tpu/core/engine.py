@@ -237,8 +237,16 @@ class RateLimitEngine:
         # unconditionally, like GUBER_EXACT_KEYS; default 128, 0 disables)
         import os as _os
         _env_cap = _os.environ.get("GUBER_REPLAY_CAP")
-        self.replay_cap = (int(_env_cap) if _env_cap is not None
-                           else (128 if replay_cap is None else replay_cap))
+        if _env_cap is not None:
+            try:
+                self.replay_cap = int(_env_cap)
+            except ValueError:
+                raise ValueError(
+                    f"GUBER_REPLAY_CAP must be an integer (lanes; 0 "
+                    f"disables the replay-bound guard), got {_env_cap!r}"
+                ) from None
+        else:
+            self.replay_cap = 128 if replay_cap is None else replay_cap
         self.native = None
         if use_native in ("auto", True, "on"):
             from gubernator_tpu import native as native_mod

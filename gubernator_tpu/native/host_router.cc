@@ -839,8 +839,9 @@ bool parse_item(const uint8_t* q, const uint8_t* qend, ParsedItem* it,
 
 RepCell* rep_probe(Router* r, int32_t shard, uint64_t fp) {
   if (r->rep_cap == 0) {
+    r->rep = (RepCell*)calloc(1024, sizeof(RepCell));
+    if (!r->rep) return nullptr;  // OOM: guard degrades to off, no crash
     r->rep_cap = 1024;
-    r->rep = (RepCell*)calloc(r->rep_cap, sizeof(RepCell));
   }
   uint64_t mask = (uint64_t)r->rep_cap - 1;
   uint64_t h = fp ^ ((uint64_t)(uint32_t)shard * 0x9E3779B97F4A7C15ull);
@@ -855,8 +856,10 @@ RepCell* rep_probe(Router* r, int32_t shard, uint64_t fp) {
 void rep_grow(Router* r) {
   int64_t old_cap = r->rep_cap;
   RepCell* old = r->rep;
+  RepCell* grown = (RepCell*)calloc(old_cap * 2, sizeof(RepCell));
+  if (!grown) return;  // OOM: keep the old table (denser probing, no crash)
   r->rep_cap = old_cap * 2;
-  r->rep = (RepCell*)calloc(r->rep_cap, sizeof(RepCell));
+  r->rep = grown;
   uint64_t mask = (uint64_t)r->rep_cap - 1;
   for (int64_t i = 0; i < old_cap; i++) {
     if (old[i].seq != r->drain_seq || old[i].fp == 0) continue;
@@ -967,7 +970,12 @@ inline void stage_lane(Router* r, int32_t shard, uint64_t fp,
   if (synth && cell_live && !is_init &&
       c->agg_off >= 0 && c->agg_k == kcur[shard] && c->slot == slot &&
       c->agg_l == limit && c->agg_d == duration &&
-      c->agg_algo == (int32_t)algo) {
+      c->agg_algo == (int32_t)algo &&
+      c->agg_n < (int32_t)(COMPACT_MAX_HITS - 1)) {
+    // the cap keeps the folded count inside the 28-bit compact hits
+    // field (folds consume no lanes, so stack capacity alone does not
+    // bound it); at the cap the item below stages a fresh lane and
+    // re-arms the cell there
     // fold into the existing aggregation lane: one more hit, no new lane
     packed[c->agg_off] += 1ll << 34;
     int64_t row_lane = c->agg_off / 2;
