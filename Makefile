@@ -1,10 +1,14 @@
 # Dev targets (the reference Makefile:1-15 has only release/docker; we add
 # the working set).
 
-.PHONY: test proto bench docker lint cluster
+.PHONY: test test-core proto bench docker lint cluster
 
 test:
 	python -m pytest tests/ -x -q
+
+# per-commit run: everything except the @pytest.mark.slow soak/fuzz/e2e
+test-core:
+	python -m pytest tests/ -x -q -m "not slow"
 
 proto:
 	cd gubernator_tpu/api/proto && protoc --python_out=. gubernator.proto peers.proto

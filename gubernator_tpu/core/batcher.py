@@ -63,12 +63,22 @@ class WindowBatcher:
         # is left waiting on a collective that will never be issued.
         self.stop_at_tick: Optional[int] = None
         # The pipelined serving lane (core/pipeline.py): compact-eligible
-        # non-GLOBAL traffic coalesces into stacked dispatches with the fetch
-        # overlapped; everything else (GLOBAL, out-of-range configs, no
-        # native router, lockstep mode) stays on the legacy lanes below.
+        # non-GLOBAL traffic coalesces into stacked compact dispatches;
+        # everything else (GLOBAL, out-of-range configs, no native router)
+        # stays on the legacy lanes below.  In lockstep (mesh) mode the
+        # SAME lane runs in lockstep form: staging is continuous, the
+        # drain dispatches as slot 1 of every cluster tick (fixed shape),
+        # and the legacy stacked step is slot 2 — so mesh serving gets the
+        # compact wire + duplicate-run fold without executable divergence
+        # across processes.
         self.pipeline: Optional[DispatchPipeline] = None
-        if lockstep_clock is None:
-            self.pipeline = DispatchPipeline(engine, self._executor, metrics)
+        self.pipeline = DispatchPipeline(engine, self._executor, metrics)
+        if not self.pipeline.enabled:
+            self.pipeline = None
+        elif self.pipeline.lockstep:
+            # fallbacks must ride the tick queue, not dispatch directly
+            self.pipeline.legacy = self._legacy_lockstep
+        else:
             self.pipeline.legacy = self._legacy_process
 
     async def _legacy_process(self, reqs: Sequence[RateLimitReq]
@@ -81,11 +91,25 @@ class WindowBatcher:
         return await loop.run_in_executor(
             self._executor, lambda: self.engine.process(reqs, now))
 
+    async def _legacy_lockstep(self, reqs: Sequence[RateLimitReq]
+                               ) -> List[RateLimitResp]:
+        """Lockstep-mode pipeline fallback: a direct engine.process would
+        dispatch OUTSIDE the tick sequence and desync the mesh — fallbacks
+        instead join the tick queue and ride the next cluster tick, with
+        per-item error semantics like submit_now."""
+        loop = asyncio.get_running_loop()
+        futs = [loop.create_future() for _ in reqs]
+        self._pending.extend((r, True, f) for r, f in zip(reqs, futs))
+        results = await asyncio.gather(*futs, return_exceptions=True)
+        return [r if isinstance(r, RateLimitResp)
+                else RateLimitResp(error=str(r)) for r in results]
+
     async def submit_rpc(self, data: bytes, peer_mode: bool = False):
         """Serve a whole serialized GetRateLimitsReq (or, with peer_mode,
         an authoritative GetPeerRateLimitsReq) through the pipeline; None
-        => caller must use the full path (including in lockstep mode,
-        which has no pipeline)."""
+        => caller must use the full path (always the case in lockstep
+        mode, whose pipeline keeps the raw-RPC lane gated off —
+        rpc_enabled — because mesh routes by shard, not by ring)."""
         if self.pipeline is None:
             return None
         return await self.pipeline.submit_rpc(data, peer_mode=peer_mode)
@@ -118,13 +142,32 @@ class WindowBatcher:
                     windows.append(self._take_window())
                 except Exception:  # defensive: the tick loop must never die
                     windows.append([])
+            now = self.clock.next_now()
+            # tick sequence, identical on every process: [compact drain,
+            # legacy stacked step].  Both land on the single-thread engine
+            # executor in submission order, so queueing the drain first
+            # fixes the collective order process-wide.
+            drain_fut = None
+            if self.pipeline is not None and self.pipeline.lockstep:
+                drain_fut = self.pipeline.lockstep_pump(
+                    now, max(self.behaviors.lockstep_stack, 1))
             try:
-                await self._run_lockstep_window(windows)
+                await self._run_lockstep_window(windows, now)
+                if drain_fut is not None:
+                    # surfaces only irrecoverable drain-dispatch failure
+                    # (the zero-stack realign also failed): fail-stop
+                    await drain_fut
             except Exception:
                 # dispatch irrecoverably failed (see the fail-stop in
                 # _run_lockstep_window): stop ticking and fail everything
-                # still queued instead of silently desyncing the mesh
+                # still queued instead of silently desyncing the mesh.
+                # Close the pipeline FIRST — it fails its queued
+                # singles/jobs with an error (no tick will ever drain
+                # them); fallback jobs already re-routed by
+                # _legacy_lockstep sit in _pending and fail below
                 self._failed = True
+                if self.pipeline is not None:
+                    self.pipeline.close()
                 for _, _, fut in self._pending:
                     if not fut.done():
                         fut.set_exception(
@@ -153,13 +196,13 @@ class WindowBatcher:
         window, self._pending = ok[:fit], ok[fit:]
         return window
 
-    async def _run_lockstep_window(self, windows: List[List[tuple]]) -> None:
-        """Dispatch one tick: `windows` is the tick's window list —
-        length 1 (classic) or lockstep_stack (stacked, one device call via
-        engine.step_stacked).  Either way the tick issues EXACTLY one
-        dispatch of the tick's agreed executable shape."""
+    async def _run_lockstep_window(self, windows: List[List[tuple]],
+                                   now: int) -> None:
+        """Dispatch one tick's legacy stacked step: `windows` is the tick's
+        window list — length 1 (classic) or lockstep_stack (stacked, one
+        device call via engine.step_stacked).  Either way this issues
+        EXACTLY one dispatch of the tick's agreed executable shape."""
         stacked = self.behaviors.lockstep_stack > 1
-        now = self.clock.next_now()
         loop = asyncio.get_running_loop()
         start = time.monotonic()
         n_reqs = sum(len(w) for w in windows)
@@ -167,9 +210,14 @@ class WindowBatcher:
         # no matter what step() does.  windows_processed increments once per
         # dispatch (K times for a stacked tick), so compare it instead of
         # guessing whether step() raised before or after its device work.
-        before = self.engine.windows_processed
+        # Captured INSIDE run() (on the engine thread): the tick's drain
+        # dispatch is queued ahead of us on the same executor and also
+        # advances the counter, so a loop-thread read here would be stale.
+        before = None
 
         def run():
+            nonlocal before
+            before = self.engine.windows_processed
             if stacked:
                 return self.engine.step_stacked(
                     [[t[0] for t in w] for w in windows], now,
@@ -225,13 +273,13 @@ class WindowBatcher:
 
     async def submit(self, req: RateLimitReq, accumulate: bool = True) -> RateLimitResp:
         """Queue into the current window; resolves when the window executes."""
+        if self._failed:
+            raise RuntimeError("lockstep dispatch failed; "
+                               "this host left the mesh")
         if (self.pipeline is not None and accumulate
                 and self.pipeline.eligible(req)):
             return await self.pipeline.submit_one(req)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        if self._failed:
-            raise RuntimeError("lockstep dispatch failed; "
-                               "this host left the mesh")
         self._pending.append((req, accumulate, fut))
         if self.clock is not None:
             return await fut  # the tick loop drains on the cluster cadence

@@ -216,11 +216,17 @@ class RateLimitEngine:
         # out-of-range config enters the arena via the full path, stored
         # limits/durations may exceed what the compact response can carry, so
         # compact dispatch is disabled for the engine's lifetime (see the
-        # format note in ops/kernel.py).  Mesh mode always uses the full
-        # format: eligibility is a per-host data-dependent choice, and hosts
-        # picking different executables for the same lockstep window would
-        # wedge the collectives.
+        # format note in ops/kernel.py).  Mesh mode's LEGACY step paths
+        # always use the full format: per-window compact eligibility is a
+        # per-host data-dependent choice, and hosts picking different
+        # executables for the same lockstep window would wedge the
+        # collectives.  The lockstep pipeline drain instead keeps the
+        # EXECUTABLE fixed every tick and moves the data-dependence into
+        # STAGING (_compact_sound gates which lanes enter the compact
+        # stack; the drain dispatches either way), so mesh serving gets
+        # the compact wire + fold without executable divergence.
         self._compact_enabled = not self.multiprocess
+        self._compact_sound = True
         self.windows_processed = 0
         self.decisions_processed = 0
         # occupied-prefix lane buckets (see _lane_bucket): powers-of-4 steps
@@ -876,7 +882,27 @@ class RateLimitEngine:
         collective contract) and identical replicated upd/ups/nows.
         """
         if not compact_safe:
+            # legacy contract: unscanned stacks conservatively disable
+            # compact dispatch for the engine (test_compact_wire pins it)
             self._compact_enabled = False
+            if self._compact_sound:
+                if isinstance(batches.slot, np.ndarray):
+                    # host staging: the real cfg-range scan (occupied
+                    # lanes only — the reused stacked buffers carry stale
+                    # values in padded lanes).  Keeps _compact_sound
+                    # accurate on the mesh lockstep tick path so the
+                    # pipeline drain may keep staging compact lanes.
+                    occ = batches.slot >= 0
+                    ok = bool((((batches.limit >= 0)
+                                & (batches.limit < kernel.COMPACT_MAX_LIMIT)
+                                & (batches.duration >= 0)
+                                & (batches.duration
+                                   < kernel.COMPACT_MAX_DURATION))
+                               | ~occ).all())
+                else:
+                    ok = False  # resident arrays: unscannable
+                if not ok:
+                    self._compact_sound = False
         k = int(batches.slot.shape[0])
         if n_decisions is None:
             if (isinstance(batches.slot, np.ndarray)
@@ -1042,6 +1068,16 @@ class RateLimitEngine:
                 _, _, mism = self.pipeline_dispatch(
                     packed, np.full(kb, now, np.int64), n_windows=0)
             jax.device_get(mism)
+        elif self.native is not None and self.multiprocess:
+            # mesh lockstep drain: ONE fixed shape (the tick's k_stack),
+            # dispatched collectively — every process warms it together
+            kb = max(k_stack or 1, 1)
+            packed = np.zeros(
+                (kb, self.num_local_shards, self.batch_per_shard, 2),
+                np.int64)
+            _, _, mism = self.pipeline_dispatch(
+                packed, np.full(kb, now, np.int64), n_windows=0)
+            self._fetch_local_stacked(mism)
 
     def _resolve_now(self, now: Optional[int]) -> int:
         """Default `now` to wall clock — except in mesh mode, where the
@@ -1064,17 +1100,22 @@ class RateLimitEngine:
         A limit/duration violation disables compact dispatch permanently —
         those values persist in the arena and could later saturate a compact
         response.  A hits violation only routes THIS window to the full
-        path: hits are consumed, not stored."""
-        if not self._compact_enabled:
-            return False
-        cfg_ok = (
-            bool((buf.limit >= 0).all())
-            and bool((buf.limit < kernel.COMPACT_MAX_LIMIT).all())
-            and bool((buf.duration >= 0).all())
-            and bool((buf.duration < kernel.COMPACT_MAX_DURATION).all())
-        )
-        if not cfg_ok:
-            self._compact_enabled = False
+        path: hits are consumed, not stored.
+
+        The cfg scan runs even when compact dispatch is already off (mesh
+        legacy path): it maintains _compact_sound, which gates what the
+        lockstep pipeline drain may STAGE in compact form."""
+        if self._compact_sound:
+            cfg_ok = (
+                bool((buf.limit >= 0).all())
+                and bool((buf.limit < kernel.COMPACT_MAX_LIMIT).all())
+                and bool((buf.duration >= 0).all())
+                and bool((buf.duration < kernel.COMPACT_MAX_DURATION).all())
+            )
+            if not cfg_ok:
+                self._compact_enabled = False
+                self._compact_sound = False
+        if not self._compact_enabled or not self._compact_sound:
             return False
         return (
             bool((buf.hits >= 0).all())
@@ -1234,17 +1275,25 @@ class RateLimitEngine:
         (GLOBAL traffic needs the control plane + psum and rides the legacy
         step path, serialized on the same executor thread).
 
-        packed: i64[K, S, B, 2] compact request stack (numpy or resident);
-        nows: i64[K] per-window timestamps.  Returns un-fetched device
-        arrays (words i64[K, S, B], limits i64[K, S, B], mism bool[K, S]):
+        packed: i64[K, S_local, B, 2] compact request stack (numpy or
+        resident); nows: i64[K] per-window timestamps.  Returns un-fetched
+        device arrays (words i64[K, S, B], limits i64[K, S, B], mism
+        bool[K, S]; fetch the local blocks with _fetch_local_stacked):
         the caller overlaps their fetch with the next drain's dispatch and
         reads `limits` only when a mismatch flag fired (see
         kernel.encode_output_word).
+
+        Mesh mode: the drain is part of the lockstep collective contract —
+        every process must dispatch it at the same sequence position with
+        the SAME K and identical `nows`, every tick, even when its own
+        stack is empty (an all-zero stack stages no lanes and is inert).
+        Per-host compact ELIGIBILITY never changes the executable: an
+        unsound host just stops staging lanes (core/pipeline.py
+        lockstep mode) while still issuing the dispatch.
         """
         if self.multiprocess:
-            raise NotImplementedError(
-                "the dispatch pipeline is standalone-only; mesh serving "
-                "dispatches on the lockstep clock")
+            packed = self._sharded_in_stacked(np.ascontiguousarray(packed))
+            nows = self._repl_in(np.asarray(nows, np.int64))
         fn = _compiled_pipeline_step(self.mesh)
         with jax.profiler.StepTraceAnnotation(
                 "guber_drain", step_num=self.windows_processed):

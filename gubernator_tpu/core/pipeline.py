@@ -299,8 +299,13 @@ class DispatchPipeline:
                  metrics=None, k_max: int = PIPELINE_K_BUCKETS[-1],
                  depth: int = 3):
         self.engine = engine
-        self.enabled = (engine.native is not None
-                        and not engine.multiprocess)
+        # Mesh (multiprocess) engines run the pipeline in LOCKSTEP mode:
+        # staging is continuous, but drains dispatch only on the cluster
+        # tick (lockstep_pump) with a fixed stack shape, so every process
+        # issues the identical executable sequence.  The raw-RPC splicing
+        # lane stays off (mesh routes by shard, not by ring).
+        self.lockstep = engine.multiprocess
+        self.enabled = engine.native is not None
         self.metrics = metrics
         self._engine_executor = engine_executor
         self.k_max = k_max
@@ -313,7 +318,7 @@ class DispatchPipeline:
         # it; the drain re-reads it on the ENGINE thread so a membership
         # change that races an in-flight RPC falls back instead of deciding
         # keys this node does not own.
-        self.rpc_enabled = self.enabled
+        self.rpc_enabled = self.enabled and not self.lockstep
         # set by the batcher: async callable (reqs, accumulate) -> resps,
         # used when a list job needs the full path (legacy lane)
         self.legacy: Optional[Callable] = None
@@ -348,6 +353,18 @@ class DispatchPipeline:
         # strong refs to every in-flight delivery-path task (the loop keeps
         # only weak ones; a GC'd task would hang the futures it owes)
         self._tasks: set = set()
+        # Submit-side coalescing (the reference's 500µs BatchWait,
+        # config.go:60-62): when drain slots are FREE and the queue is
+        # small, wait up to coalesce_wait for more arrivals instead of
+        # dispatching a tiny drain.  On a tunneled chip every fetch costs
+        # the same ~70ms regardless of size, so drains-per-fetch-slot is
+        # the whole game: a herd of single-item RPCs otherwise burns the
+        # fetch pool on near-empty drains (round-4 thundering-herd p99).
+        # Saturated mode is unaffected: completion callbacks pump with
+        # force=True, so at depth the cadence is completion-driven.
+        self.coalesce_wait = 0.0005
+        self.coalesce_min = MAX_BATCH_SIZE  # decisions that skip the wait
+        self._coalesce_handle = None
 
     def _spawn(self, coro) -> None:
         """create_task with a strong reference held until completion."""
@@ -395,18 +412,26 @@ class DispatchPipeline:
 
     def eligible(self, req: RateLimitReq) -> bool:
         """May this request ride the pipeline?  Mirrors the C-side range
-        checks exactly, so a pipeline job never range-falls-back."""
-        return (
-            self.enabled
-            and not self._closed
-            and self.engine._compact_enabled
-            and req.behavior != Behavior.GLOBAL
-            and req.algorithm in (Algorithm.TOKEN_BUCKET,
-                                  Algorithm.LEAKY_BUCKET)
-            and 0 <= req.hits < kernel.COMPACT_MAX_HITS
-            and 0 <= req.limit < kernel.COMPACT_MAX_LIMIT
-            and 0 <= req.duration < kernel.COMPACT_MAX_DURATION
-        )
+        checks exactly, so a pipeline job never range-falls-back.
+
+        Lockstep (mesh) mode gates on _compact_sound — per-host staging
+        soundness — instead of _compact_enabled (which is off for mesh
+        legacy dispatch), and additionally requires the key to route to
+        THIS process's shards (mis-routed keys take the legacy lane,
+        which fails them individually with the routing error)."""
+        if not (self.enabled
+                and not self._closed
+                and req.behavior != Behavior.GLOBAL
+                and req.algorithm in (Algorithm.TOKEN_BUCKET,
+                                      Algorithm.LEAKY_BUCKET)
+                and 0 <= req.hits < kernel.COMPACT_MAX_HITS
+                and 0 <= req.limit < kernel.COMPACT_MAX_LIMIT
+                and 0 <= req.duration < kernel.COMPACT_MAX_DURATION):
+            return False
+        if self.lockstep:
+            return (self.engine._compact_sound
+                    and self.engine.routing_error(req) is None)
+        return self.engine._compact_enabled
 
     # ------------------------------------------------------------ pump
 
@@ -422,9 +447,25 @@ class DispatchPipeline:
         self._jobs = []
         return jobs
 
-    def _pump(self) -> None:
+    def _pump(self, force: bool = False) -> None:
+        if self.lockstep:
+            return  # drains happen only on the cluster tick (lockstep_pump)
         if self._closed or self._in_flight >= self.depth:
             return
+        if not force and self.coalesce_wait > 0:
+            # RpcJobs are unparsed here: estimate items from the wire size
+            # (>= ~16B/item, so this overestimates — big RPCs never wait)
+            pending = (len(self._singles)
+                       + sum(len(j.data) // 16 if isinstance(j, RpcJob)
+                             else j.n for j in self._jobs))
+            if 0 < pending < self.coalesce_min:
+                if self._coalesce_handle is None:
+                    self._coalesce_handle = self._loop.call_later(
+                        self.coalesce_wait, self._coalesce_fire)
+                return
+        if self._coalesce_handle is not None:
+            self._coalesce_handle.cancel()
+            self._coalesce_handle = None
         jobs = self._take_jobs()
         if not jobs:
             return
@@ -432,6 +473,30 @@ class DispatchPipeline:
         fut = self._loop.run_in_executor(self._engine_executor,
                                          self._drain_sync, jobs)
         fut.add_done_callback(lambda f: self._on_dispatched(f, jobs))
+
+    def _coalesce_fire(self) -> None:
+        self._coalesce_handle = None
+        self._pump(force=True)
+
+    def lockstep_pump(self, now: int, k_stack: int):
+        """Issue this tick's drain (mesh mode, event loop).  The dispatch
+        ALWAYS happens — the drain executable is slot 1 of the tick's
+        collective sequence on every process, staged lanes or not — and
+        runs on the single-thread engine executor, so the caller orders
+        the tick's legacy dispatch after it by submitting second.  Returns
+        the dispatch future: awaiting it surfaces an irrecoverable
+        dispatch failure (collective desync) for the batcher's fail-stop.
+        """
+        assert self.lockstep
+        if self._loop is None:
+            self._loop = asyncio.get_running_loop()
+        jobs = self._take_jobs() if not self._closed else []
+        self._in_flight += 1
+        fut = self._loop.run_in_executor(
+            self._engine_executor,
+            lambda: self._drain_sync(jobs, now=now, k_fixed=k_stack))
+        fut.add_done_callback(lambda f: self._on_dispatched(f, jobs))
+        return fut
 
     def _on_dispatched(self, fut, jobs) -> None:
         try:
@@ -441,7 +506,7 @@ class DispatchPipeline:
             self._in_flight -= 1
             for job in jobs:
                 self._resolve_error(job, e)
-            self._pump()
+            self._pump(force=True)
             return
         # fallback jobs re-route outside the pipeline
         for job in res.fallback:
@@ -453,11 +518,11 @@ class DispatchPipeline:
             self._in_flight -= 1
             for job in res.staged:
                 self._resolve_error(job, res.error)
-            self._pump()
+            self._pump(force=True)
             return
         if not res.staged:
             self._in_flight -= 1
-            self._pump()
+            self._pump(force=True)
             return
         # start forwards for cluster-mode mixed RPCs NOW, so the peer round
         # trips overlap the local stack's fetch.  Forwards COALESCE across
@@ -472,7 +537,7 @@ class DispatchPipeline:
                                           self._complete_sync, res)
         cfut.add_done_callback(lambda f: self._on_completed(f, res))
         # a second drain may dispatch while this one's fetch is in flight
-        self._pump()
+        self._pump(force=True)
 
     def _spawn_forwards(self, jobs: List[RpcJob], ring_peers) -> None:
         """Forward the drain's remote items to their ring owners as spliced
@@ -553,7 +618,7 @@ class DispatchPipeline:
             log.exception("pipeline fetch failed")
             for job in res.staged:
                 self._resolve_error(job, e)
-            self._pump()
+            self._pump(force=True)
             return
         for job, out in zip(res.staged, outs):
             if isinstance(job, RpcJob):
@@ -576,7 +641,7 @@ class DispatchPipeline:
                 time.monotonic() - res.started)
             self.metrics.agg_decisions.inc(res.n_decisions)
             self.metrics.agg_lanes.inc(res.n_lanes)
-        self._pump()
+        self._pump(force=True)
 
     async def _assemble_mixed(self, job: RpcJob, local_parts, now) -> None:
         """Splice a mixed RPC's locally-encoded framed segments with its
@@ -641,21 +706,30 @@ class DispatchPipeline:
 
     # ------------------------------------------------------------ engine side
 
-    def _drain_sync(self, jobs: List[object]) -> _DrainResult:
+    def _drain_sync(self, jobs: List[object], now: Optional[int] = None,
+                    k_fixed: Optional[int] = None) -> _DrainResult:
         """Pack every job into one stacked compact dispatch (engine thread).
 
         Fresh numpy staging per drain: the previous drain's arrays may still
-        be feeding an in-flight host→device transfer."""
+        be feeding an in-flight host→device transfer.
+
+        Lockstep mode (k_fixed set): `now` is the tick's cluster-agreed
+        timestamp and the dispatch shape is ALWAYS [k_fixed] — issued even
+        with nothing staged, because the drain is part of the tick's
+        collective sequence on every process."""
         eng = self.engine
         native = eng.native
         S = eng.num_local_shards
         B = eng.batch_per_shard
-        K = self.k_max
+        K = self.k_max if k_fixed is None else k_fixed
         res = _DrainResult()
         res.started = time.monotonic()
-        res.now = now = self.now_fn()
+        if now is None:
+            now = self.now_fn()
+        res.now = now
         rpc_ok = self.rpc_enabled and eng._compact_enabled
-        list_ok = eng._compact_enabled
+        list_ok = (eng._compact_sound if self.lockstep
+                   else eng._compact_enabled)
 
         packed = np.zeros((K, S, B, 2), np.int64)
         fills = np.zeros((K, S), np.int32)
@@ -708,10 +782,52 @@ class DispatchPipeline:
                 else:
                     res.fallback.append(job)
 
-        if not res.staged:
+        if not res.staged and not self.lockstep:
             return res
         k_used = int(fills.any(axis=1).sum())
-        if k_used:  # an all-forwarded drain has nothing to dispatch
+        if self.lockstep:
+            # the tick's drain dispatch is unconditional and fixed-shape:
+            # every process issues it at the same sequence position
+            before = eng.windows_processed
+            dispatched = False
+            try:
+                words, limits, mism = eng.pipeline_dispatch(
+                    packed, np.full(K, now, np.int64), n_windows=k_used)
+                dispatched = True  # sentinel: windows_processed advances
+                # by k_used, which is 0 on an idle tick — the counter
+                # alone cannot distinguish 'dispatched 0 windows' from
+                # 'never dispatched' for the realign decision below
+                native.commit()
+            except Exception as e:
+                native.abort()
+                res.error = e  # _on_dispatched fails the staged jobs
+                # keep the collective sequence aligned: this process MUST
+                # still issue the tick's drain executable (unless the
+                # failed call already did).  Retry with an inert all-zero
+                # stack; if even that cannot dispatch, the host can never
+                # rejoin the lockstep — raise so the batcher fail-stops
+                # instead of silently desyncing.
+                if not dispatched and eng.windows_processed == before:
+                    zeros = np.zeros_like(packed)
+                    for attempt in range(3):
+                        try:
+                            eng.pipeline_dispatch(
+                                zeros, np.full(K, now, np.int64),
+                                n_windows=0)
+                            break
+                        except Exception:
+                            if attempt == 2:
+                                raise
+                            time.sleep(0.05)
+                return res
+            if res.staged:
+                try:
+                    words.copy_to_host_async()
+                    mism.copy_to_host_async()
+                except Exception:
+                    pass  # fetch path will block instead
+                res.words, res.limits, res.mism = words, limits, mism
+        elif k_used:  # an all-forwarded drain has nothing to dispatch
             kb = next(b for b in self._k_buckets if b >= k_used)
             try:
                 words, limits, mism = eng.pipeline_dispatch(
@@ -750,17 +866,22 @@ class DispatchPipeline:
     # ------------------------------------------------------------ fetch side
 
     def _complete_sync(self, res: _DrainResult):
-        B = self.engine.batch_per_shard
+        eng = self.engine
+        B = eng.batch_per_shard
         if res.words is None:  # all-forwarded drain: nothing was dispatched
             wflat = np.empty((0, B), np.int64)
             clflat = None
         else:
-            words = np.ascontiguousarray(np.asarray(res.words))
-            mism = np.asarray(res.mism)
+            # _fetch_local_stacked: this process's shard blocks of the
+            # global [K, S, ...] arrays (plain device_get single-process);
+            # rows then index as k * S_local + shard, exactly how the C
+            # router staged them
+            words = np.ascontiguousarray(eng._fetch_local_stacked(res.words))
+            mism = eng._fetch_local_stacked(res.mism)
             clflat = None
             if mism.any():
                 clflat = np.ascontiguousarray(
-                    np.asarray(res.limits)).reshape(-1, B)
+                    eng._fetch_local_stacked(res.limits)).reshape(-1, B)
             wflat = words.reshape(-1, B)
         outs = [job.finish(self, wflat, clflat, res.now)
                 for job in res.staged]
@@ -770,6 +891,9 @@ class DispatchPipeline:
         if not self.enabled:
             return
         self._closed = True
+        if self._coalesce_handle is not None:
+            self._coalesce_handle.cancel()
+            self._coalesce_handle = None
         # fail still-queued jobs: _pump returns early once closed, so their
         # futures would otherwise never resolve and callers hang
         err = RuntimeError("pipeline closed")

@@ -32,6 +32,7 @@ def _payload(n, name="chaos"):
     ]).SerializeToString()
 
 
+@pytest.mark.slow
 def test_peer_death_then_heal(loop):
     async def body():
         c = await cluster_mod.start(3)

@@ -117,6 +117,26 @@ def test_step_windows_disables_compact_unless_safe():
     assert not eng._compact_enabled
 
 
+def test_stacked_cfg_scan_maintains_compact_sound():
+    """step_stacked's host staging is scanned for compact-saturating
+    configs: in-range stacks keep _compact_sound (the mesh lockstep
+    drain's staging gate) even though unscanned-unsafe dispatch drops
+    _compact_enabled; a genuinely out-of-range config clears both."""
+    eng = make_engine("auto")
+    assert eng._compact_sound
+    eng.step_stacked([[RateLimitReq(name="cs", unique_key="a", hits=1,
+                                    limit=5, duration=60_000)]], now=T0)
+    # stacked dispatch is conservative for the legacy compact path...
+    assert not eng._compact_enabled
+    # ...but the scan proved the stored configs are in range
+    assert eng._compact_sound
+    eng.step_stacked([[RateLimitReq(
+        name="cs", unique_key="big", hits=1,
+        limit=int(kernel.COMPACT_MAX_LIMIT) + 7, duration=60_000)]],
+        now=T0 + 1)
+    assert not eng._compact_sound
+
+
 def test_wire_roundtrip_exact():
     """encode_batch_host -> decode_batch and encode_output_compact ->
     decode_output_host are exact inverses over the eligible ranges."""

@@ -73,6 +73,7 @@ def test_env_file_malformed(clean_env, tmp_path):
         load_env_file(str(f))
 
 
+@pytest.mark.slow
 def test_daemon_end_to_end(clean_env):
     """Boot the full daemon (static discovery), drive gRPC + HTTP surfaces."""
     from gubernator_tpu.daemon import Daemon

@@ -92,6 +92,7 @@ class FakeEtcd:
                 self.watchers.remove(resp)
 
 
+@pytest.mark.slow
 def test_etcd_pool_register_watch():
     async def body():
         fake = FakeEtcd()

@@ -1,0 +1,84 @@
+"""Tail-latency boundedness of the serving pipeline under a thundering
+herd (reference BenchmarkServer_ThunderingHeard, benchmark_test.go:109).
+
+The structural property under test: a request admitted to the pipeline
+waits at most ~2 drain cycles (coalesce window + at-depth queueing) before
+its own drain's dispatch+fetch — it must never stall for many cycles
+behind other traffic.  Measured here CPU-smoke without gRPC (the herd
+p99 through a real socket measures Python gRPC on this 1-core box as much
+as the engine; the pipeline is the part this framework owns).
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import gubernator_tpu  # noqa: F401
+from gubernator_tpu import native
+from gubernator_tpu.api.types import RateLimitReq
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.core.batcher import WindowBatcher
+from gubernator_tpu.core.engine import RateLimitEngine
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native router unavailable")
+
+
+@pytest.mark.slow  # 2s wall-clock soak with latency percentiles: jitter
+# on a loaded box should not gate per-commit runs
+def test_herd_p99_bounded_by_drain_cycles():
+    eng = RateLimitEngine(capacity_per_shard=4096, batch_per_shard=512,
+                          global_capacity=16, global_batch_per_shard=8,
+                          max_global_updates=8, use_native="on")
+    eng.warmup()
+    b = WindowBatcher(eng, BehaviorConfig())
+    assert b.pipeline is not None and b.pipeline.enabled
+
+    HERD = 100
+    lat = []
+    drains_before = None
+
+    async def run():
+        nonlocal drains_before
+        # warm the drain path (first drain compiles nothing new after
+        # warmup, but fills slot tables)
+        await asyncio.gather(*(b.submit(RateLimitReq(
+            name="hw", unique_key=f"w{i}", hits=1, limit=100_000,
+            duration=60_000)) for i in range(HERD)))
+        drains_before = eng.windows_processed
+        stop = time.perf_counter() + 2.0
+
+        async def worker(wid):
+            req = RateLimitReq(name="hd", unique_key=f"t{wid}", hits=1,
+                               limit=100_000, duration=60_000)
+            while time.perf_counter() < stop:
+                t = time.perf_counter()
+                r = await b.submit(req)
+                lat.append(time.perf_counter() - t)
+                assert not r.error
+
+        await asyncio.gather(*(worker(w) for w in range(HERD)))
+
+    try:
+        asyncio.run(run())
+    finally:
+        b.close()
+
+    lat_ms = np.array(lat) * 1e3
+    p50 = float(np.percentile(lat_ms, 50))
+    p99 = float(np.percentile(lat_ms, 99))
+    n_drains = eng.windows_processed - drains_before
+    cycle_ms = 2000.0 / max(n_drains, 1)  # mean drain cadence over the run
+    # Structural bound: one coalesce window + at-depth queueing (~2 drain
+    # cycles) + the request's own drain.  4 cycles + 25ms slack absorbs
+    # 1-core scheduling jitter while still failing on multi-cycle stalls
+    # (the round-4 herd showed ~100x-cycle tails).
+    bound = 4 * cycle_ms + 25.0
+    assert p99 <= bound, (
+        f"herd p99 {p99:.1f}ms exceeds {bound:.1f}ms "
+        f"(~4 drain cycles of {cycle_ms:.1f}ms + slack); p50 {p50:.1f}ms, "
+        f"{n_drains} drains in 2s, {len(lat)} requests")
+    # and the tail must not be a multiple of the median (stall signature)
+    assert p99 <= max(8 * p50, p50 + 30.0), (p50, p99)

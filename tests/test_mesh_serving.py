@@ -115,6 +115,13 @@ def _child(pid, coord_port, grpc0, grpc1, ctrl_port, stack=1):
                         await writer.drain()
                     elif line.startswith("STOP"):
                         _, t = line.split()
+                        # the compact lockstep drain (not the legacy full
+                        # stack) must have carried the forwarded regular
+                        # traffic that landed on this node
+                        pipe = inst.batcher.pipeline
+                        assert pipe is not None and pipe.lockstep
+                        assert pipe.lanes_staged > 0, \
+                            "mesh drain never staged a lane"
                         inst.batcher.stop_at_tick = int(t)
                         writer.write(b"STOPPING\n")
                         await writer.drain()
@@ -189,6 +196,13 @@ def _child(pid, coord_port, grpc0, grpc1, ctrl_port, stack=1):
         resp = (await reader.readline()).decode().strip()
         assert resp == "OK", f"B's dynamic-global replica disagrees: {resp}"
 
+        # the compact lockstep drain must have carried the local regular
+        # traffic (the legacy stack only carries GLOBAL + fallbacks now)
+        pipe = inst.batcher.pipeline
+        assert pipe is not None and pipe.lockstep
+        assert pipe.lanes_staged > 0, "mesh drain never staged a lane"
+        assert pipe.decisions_staged >= pipe.lanes_staged > 0
+
         stop_tick = inst.batcher.clock.tick + 40
         writer.write(f"STOP {stop_tick}\n".encode())
         await writer.drain()
@@ -206,6 +220,7 @@ import pytest  # noqa: E402
 
 
 @pytest.mark.parametrize("stack", [1, 2])
+@pytest.mark.slow
 def test_mesh_serving_two_nodes(stack):
     """stack=2 drives the stacked lockstep tick (engine.step_stacked): two
     windows per collective dispatch on the cluster clock."""

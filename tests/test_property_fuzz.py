@@ -53,6 +53,7 @@ def _engines():
     return engines
 
 
+@pytest.mark.slow
 @settings(max_examples=40, deadline=None,
           suppress_health_check=[HealthCheck.too_slow])
 @given(workload_st)

@@ -29,6 +29,7 @@ def _payload(lo, hi):
     ]).SerializeToString()
 
 
+@pytest.mark.slow
 def test_mixed_lane_hit_accounting():
     async def body():
         inst = Instance(Config(
