@@ -156,6 +156,16 @@ def _env(name: str, default: str = "") -> str:
     return v if v not in (None, "") else default
 
 
+def env_int(name: str, default: int, minimum: int = 1) -> int:
+    """Integer GUBER_* knob with a floor; malformed values fall back to
+    the default (perf tunables must never crash a boot).  Shared by the
+    engine's GUBER_PIPELINE_KMAX and the pipeline's GUBER_FETCH_WORKERS."""
+    try:
+        return max(minimum, int(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
 def load_env_file(path: str) -> None:
     """Load a KEY=value file into the process env (reference
     cmd/gubernator/config.go:239-267): '#' comments, blank lines skipped,

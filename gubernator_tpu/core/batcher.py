@@ -71,8 +71,16 @@ class WindowBatcher:
         # and the legacy stacked step is slot 2 — so mesh serving gets the
         # compact wire + duplicate-run fold without executable divergence
         # across processes.
+        if engine.multiprocess and lockstep_clock is None:
+            # fail loudly at construction: without a tick loop nothing
+            # would ever drain a multiprocess engine's windows, and
+            # eligible submits would hang forever
+            raise ValueError("a multiprocess (mesh) engine needs a "
+                             "lockstep_clock-driven WindowBatcher")
         self.pipeline: Optional[DispatchPipeline] = None
-        self.pipeline = DispatchPipeline(engine, self._executor, metrics)
+        self.pipeline = DispatchPipeline(
+            engine, self._executor, metrics,
+            lockstep=lockstep_clock is not None)
         if not self.pipeline.enabled:
             self.pipeline = None
         elif self.pipeline.lockstep:
@@ -142,16 +150,17 @@ class WindowBatcher:
                     windows.append(self._take_window())
                 except Exception:  # defensive: the tick loop must never die
                     windows.append([])
-            now = self.clock.next_now()
-            # tick sequence, identical on every process: [compact drain,
-            # legacy stacked step].  Both land on the single-thread engine
-            # executor in submission order, so queueing the drain first
-            # fixes the collective order process-wide.
-            drain_fut = None
-            if self.pipeline is not None and self.pipeline.lockstep:
-                drain_fut = self.pipeline.lockstep_pump(
-                    now, max(self.behaviors.lockstep_stack, 1))
             try:
+                now = self.clock.next_now()
+                # tick sequence, identical on every process: [compact
+                # drain, legacy stacked step].  Both land on the
+                # single-thread engine executor in submission order, so
+                # queueing the drain first fixes the collective order
+                # process-wide.
+                drain_fut = None
+                if self.pipeline is not None and self.pipeline.lockstep:
+                    drain_fut = self.pipeline.lockstep_pump(
+                        now, max(self.behaviors.lockstep_stack, 1))
                 await self._run_lockstep_window(windows, now)
                 if drain_fut is not None:
                     # surfaces only irrecoverable drain-dispatch failure

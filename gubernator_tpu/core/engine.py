@@ -55,7 +55,19 @@ from gubernator_tpu.state.arena import SlotTable
 # drain dispatches its windows padded up to the nearest bucket, and
 # warmup() pre-compiles exactly these shapes.  Single source of truth —
 # a bucket missing here would compile mid-serving on the engine thread.
-PIPELINE_K_BUCKETS = (1, 2, 4, 8)
+# Stacked-drain depth ladder: each bucket is one compiled executable (the
+# scan body is K-independent, so deeper stacks amortize the per-dispatch
+# cost linearly — the decisions-per-dispatch lever).  GUBER_PIPELINE_KMAX
+# extends the ladder without code changes once the on-chip stack-depth
+# probe (scripts/probe_stack_depth.py) picks the serving optimum.
+def _k_buckets_from_env():
+    from gubernator_tpu.config import env_int
+    kmax = env_int("GUBER_PIPELINE_KMAX", 8)
+    base = [1, 2, 4, 8, 16, 32, 64, 128, 256]
+    return tuple(b for b in base if b < kmax) + (kmax,)
+
+
+PIPELINE_K_BUCKETS = _k_buckets_from_env()
 
 
 def shard_of(key: str, num_shards: int) -> int:

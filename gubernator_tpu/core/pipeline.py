@@ -297,14 +297,22 @@ class DispatchPipeline:
 
     def __init__(self, engine, engine_executor: ThreadPoolExecutor,
                  metrics=None, k_max: int = PIPELINE_K_BUCKETS[-1],
-                 depth: int = 3):
+                 depth: int = 3, lockstep: Optional[bool] = None):
         self.engine = engine
-        # Mesh (multiprocess) engines run the pipeline in LOCKSTEP mode:
-        # staging is continuous, but drains dispatch only on the cluster
-        # tick (lockstep_pump) with a fixed stack shape, so every process
-        # issues the identical executable sequence.  The raw-RPC splicing
-        # lane stays off (mesh routes by shard, not by ring).
-        self.lockstep = engine.multiprocess
+        # LOCKSTEP mode (any engine served behind a cluster tick clock;
+        # REQUIRED for multiprocess engines): staging is continuous, but
+        # drains dispatch only on the tick (lockstep_pump) with a fixed
+        # stack shape, so every process issues the identical executable
+        # sequence — and all serving shares the tick's cluster-agreed
+        # clock (one time base per arena).  The raw-RPC splicing lane
+        # stays off (mesh routes by shard, not by ring).
+        self.lockstep = (engine.multiprocess if lockstep is None
+                         else lockstep)
+        if engine.multiprocess and not self.lockstep:
+            raise ValueError(
+                "a multiprocess engine's pipeline must run in lockstep "
+                "mode (tick-driven drains keep the collective sequence "
+                "identical on every process)")
         self.enabled = engine.native is not None
         self.metrics = metrics
         self._engine_executor = engine_executor
@@ -333,12 +341,16 @@ class DispatchPipeline:
         self._closed = False
         if not self.enabled:
             return
-        # TWO fetch workers: outstanding device→host fetches overlap
-        # partially (measured ~2x on the tunneled chip), and each drain's
-        # demux is independent so out-of-order completion is safe — per-key
-        # ordering was already committed at dispatch
+        # TWO fetch workers by default: outstanding device→host fetches
+        # overlap partially (measured ~2x on the tunneled chip), and each
+        # drain's demux is independent so out-of-order completion is safe
+        # — per-key ordering was already committed at dispatch.
+        # GUBER_FETCH_WORKERS tunes the pool once the transfer-overlap
+        # factor is re-measured on real hardware.
+        from gubernator_tpu.config import env_int
         self._fetch_executor = ThreadPoolExecutor(
-            max_workers=2, thread_name_prefix="guber-fetch")
+            max_workers=env_int("GUBER_FETCH_WORKERS", 2),
+            thread_name_prefix="guber-fetch")
         self._singles: List[tuple] = []   # (req, fut)
         self._jobs: List[object] = []     # FIFO of RpcJob/ListJob
         self._in_flight = 0

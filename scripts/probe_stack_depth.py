@@ -1,0 +1,98 @@
+"""Decisions-per-dispatch scaling: drain cost vs stack depth K and lanes B.
+
+The serving drain (_compiled_pipeline_step) scans K compact windows in one
+executable; the scan BODY's op count is K-independent, so if the measured
+~48ms/32k-lane window is per-DISPATCH op overhead (the round-4 hypothesis),
+cost should be ~flat in K and decisions-per-second should scale ~linearly
+with K x B until real compute/bandwidth dominates.  This probe measures
+fetch-synced wall time per dispatch across (K, B) and prints the
+decisions/s surface — the number that picks GUBER_PIPELINE_KMAX and the
+serving lane width on real hardware.
+
+Timing: chained dispatches through the donated state with ONE final fetch
+(jax.block_until_ready is an enqueue no-op on the tunneled runtime);
+per-dispatch cost derives from reps-slope (R1 vs R2 reps) so the fetch RTT
+cancels.  Run on a live tunnel; CPU runs are for smoke only.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+_plat = os.environ.get("GUBER_PROBE_PLATFORM")
+if _plat:  # smoke runs force cpu; default = ambient (the tunnel chip)
+    jax.config.update("jax_platforms", _plat)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+from gubernator_tpu.ops import kernel  # noqa: E402
+from gubernator_tpu.ops.kernel import BucketState  # noqa: E402
+
+now0 = 1_700_000_000_000
+rng = np.random.default_rng(5)
+dev = jax.devices()[0]
+print(f"# backend: {dev.platform}", file=sys.stderr, flush=True)
+ON_CPU = dev.platform == "cpu"
+
+C = 1 << 14 if ON_CPU else 1 << 20
+KS = (1, 4) if ON_CPU else (1, 4, 16, 64, 128)
+BS = (1024,) if ON_CPU else (32768, 131072, 524288)
+R1, R2 = (2, 4) if ON_CPU else (3, 9)
+
+
+def make_packed(K, B):
+    slots = ((rng.zipf(1.1, (K, 1, B)) - 1) % C).astype(np.int64)
+    pk = np.zeros((K, 1, B, 2), np.int64)
+    pk[..., 0] = (slots + 1) | (1 << 34)  # hits=1, plain lanes
+    pk[..., 1] = np.int64(1_000_000) | (np.int64(600_000) << 32)
+    return pk
+
+
+def measure(K, B):
+    """Per-dispatch seconds by reps-slope, one warm setup, interleaved
+    samples so drift cancels alongside the fetch RTT."""
+    from gubernator_tpu.core.engine import _compiled_pipeline_step
+    from gubernator_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(jax.devices()[:1])
+    fn = _compiled_pipeline_step(mesh)
+    # leading shard axis (1 shard on 1 device): drain state is [S, C]
+    state = BucketState(*[jax.device_put(np.asarray(a)[None])
+                          for a in BucketState.zeros(C)])
+    pk = jax.device_put(make_packed(K, B))
+    nows = jax.device_put(np.full(K, now0, np.int64))
+    # warm: compile + arena fill
+    state, w, l, m = fn(state, pk, nows)
+    np.asarray(w[0, 0, :8])
+
+    def chained(reps):
+        nonlocal state
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            state, w, l, m = fn(state, pk, nows)
+        np.asarray(w[0, 0, :8])  # chained by donated state: ONE fetch
+        return time.perf_counter() - t0
+
+    chained(R1)  # second warm pass (slot tables now steady)
+    t1s, t2s = [], []
+    for _ in range(3):
+        t1s.append(chained(R1))
+        t2s.append(chained(R2))
+    return (float(np.median(t2s)) - float(np.median(t1s))) / (R2 - R1)
+
+
+for B in BS:
+    for K in KS:
+        try:
+            per = measure(K, B)
+            dps = K * B / per if per > 0 else float("nan")
+            print(f"K={K:4d} B={B:7d}: {per * 1e3:8.2f} ms/dispatch "
+                  f"-> {dps:,.0f} decisions/s", flush=True)
+        except Exception as e:  # noqa: BLE001 — keep probing other shapes
+            print(f"K={K:4d} B={B:7d}: FAILED {type(e).__name__}: "
+                  f"{str(e)[:150]}", flush=True)
