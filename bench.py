@@ -113,13 +113,16 @@ def parent_main():
 
 # --------------------------------------------------------------------- child
 
-def acquire_backend(attempts=5, probe_timeout=75.0):
+def acquire_backend(attempts=5, probe_timeout=75.0, init=True):
     """First device contact, hang-proof: each attempt PROBES the backend in
     a killable subprocess with its own timeout first (a wedged tunnel hangs
     `jax.devices()` indefinitely and uninterruptibly — round-2/4 bench
     history — and killing the probing process is also what nudges the
     tunnel to recover).  Only after a probe succeeds does this process
-    initialize jax itself."""
+    initialize jax itself.  init=False stops after a successful probe
+    WITHOUT touching jax in-process (returns None) — used to keep the
+    chip free for the stack-depth probe subprocess, since TPU runtimes
+    are single-process-exclusive."""
     plat = os.environ.get("GUBER_BENCH_PLATFORM", "")
     probe_code = (
         "import os, jax\n"
@@ -142,6 +145,8 @@ def acquire_backend(attempts=5, probe_timeout=75.0):
                 [sys.executable, "-c", probe_code],
                 timeout=this_timeout, capture_output=True)
             if proc.returncode == 0 and b"PROBE_OK" in proc.stdout:
+                if not init:
+                    return None
                 import jax
 
                 if plat:
@@ -716,10 +721,61 @@ def child_main():
             except OSError:
                 pass
 
+    def pick_stack_depth(result):
+        """Quick on-chip (K, lanes) sweep in a SUBPROCESS (compiles land
+        in the shared persistent cache) -> set GUBER_PIPELINE_KMAX before
+        gubernator_tpu imports, so the serving tiers drain at the best
+        measured stack depth.  Skipped on CPU (smoke shapes can't inform
+        the TPU choice) and on any failure — the tiers run either way."""
+        probe = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "scripts", "probe_stack_depth.py")
+        out = os.environ[OUT_ENV] + ".depth.json"
+        proc = None
+        try:
+            proc = subprocess.run(
+                [sys.executable, probe, "--quick", f"--json={out}"],
+                timeout=420, capture_output=True)
+            with open(out) as f:
+                depth = json.loads(f.read())
+            if depth.get("backend") == "cpu":
+                # smoke shapes cannot inform the TPU serving choice, and
+                # the quick CPU grid tops out BELOW the default ladder
+                log("# stack-depth probe ran on cpu; not applied")
+                return
+            result["stack_depth_probe"] = depth.get("points")
+            best = depth.get("best")
+            if best and best.get("K"):
+                os.environ["GUBER_PIPELINE_KMAX"] = str(best["K"])
+                result["serving_k_stack"] = best["K"]
+                log(f"# stack-depth probe: best K={best['K']} "
+                    f"({best['decisions_per_sec']:,.0f} decisions/s); "
+                    f"serving ladder extended")
+        except Exception as e:  # noqa: BLE001 — optional optimization
+            tail = b""
+            if proc is not None:
+                tail = (proc.stderr or proc.stdout or b"")[-300:]
+            log(f"# stack-depth probe skipped: {type(e).__name__}: "
+                f"{str(e)[:200]}"
+                + (f"; probe rc={proc.returncode} stderr tail: "
+                   f"{tail.decode(errors='replace')}" if proc is not None
+                   else ""))
+        finally:
+            try:
+                os.unlink(out)
+            except OSError:
+                pass
+
     tunnel_error = None
     try:
         try:
-            devs = acquire_backend()
+            # probe-only first (fast wedge detection, chip left free),
+            # then the stack-depth subprocess (TPU runtimes are single-
+            # process-exclusive — it must run before jax initializes
+            # HERE), then the real in-process init
+            acquire_backend(init=False)
+            if not os.environ.get("GUBER_BENCH_PLATFORM"):
+                pick_stack_depth(result)
+            devs = acquire_backend(attempts=2)
         except RuntimeError as e:
             # tunnel wedged: fall back to CPU smoke tiers so the round
             # record carries real measurements, not a bare 0.0.  Tag the

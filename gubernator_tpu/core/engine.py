@@ -63,7 +63,10 @@ from gubernator_tpu.state.arena import SlotTable
 def _k_buckets_from_env():
     from gubernator_tpu.config import env_int
     kmax = env_int("GUBER_PIPELINE_KMAX", 8)
-    base = [1, 2, 4, 8, 16, 32, 64, 128, 256]
+    # sparse above 8: every bucket is one warmup compile (tens of seconds
+    # over a tunneled chip), so the extended ladder trades shape fit for
+    # boot time
+    base = [1, 2, 4, 8, 32, 128, 512]
     return tuple(b for b in base if b < kmax) + (kmax,)
 
 

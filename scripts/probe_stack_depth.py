@@ -27,7 +27,9 @@ import jax
 _plat = os.environ.get("GUBER_PROBE_PLATFORM")
 if _plat:  # smoke runs force cpu; default = ambient (the tunnel chip)
     jax.config.update("jax_platforms", _plat)
-jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("GUBER_JAX_CACHE",
+                                 "/root/repo/.jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 
 from gubernator_tpu.ops import kernel  # noqa: E402
@@ -39,10 +41,20 @@ dev = jax.devices()[0]
 print(f"# backend: {dev.platform}", file=sys.stderr, flush=True)
 ON_CPU = dev.platform == "cpu"
 
+QUICK = "--quick" in sys.argv
+JSON_OUT = next((a.split("=", 1)[1] for a in sys.argv
+                 if a.startswith("--json=")), None)
+
 C = 1 << 14 if ON_CPU else 1 << 20
-KS = (1, 4) if ON_CPU else (1, 4, 16, 64, 128)
-BS = (1024,) if ON_CPU else (32768, 131072, 524288)
-R1, R2 = (2, 4) if ON_CPU else (3, 9)
+if QUICK:
+    # bench-integrated mode: just enough points to pick the serving K
+    KS = (1, 4) if ON_CPU else (8, 32, 128)
+    BS = (1024,) if ON_CPU else (32768,)
+    R1, R2 = (2, 4) if ON_CPU else (2, 6)
+else:
+    KS = (1, 4) if ON_CPU else (1, 4, 16, 64, 128)
+    BS = (1024,) if ON_CPU else (32768, 131072, 524288)
+    R1, R2 = (2, 4) if ON_CPU else (3, 9)
 
 
 def make_packed(K, B):
@@ -86,13 +98,31 @@ def measure(K, B):
     return (float(np.median(t2s)) - float(np.median(t1s))) / (R2 - R1)
 
 
+results = []
 for B in BS:
     for K in KS:
         try:
             per = measure(K, B)
             dps = K * B / per if per > 0 else float("nan")
+            results.append({"K": K, "B": B, "ms_per_dispatch":
+                            round(per * 1e3, 3),
+                            "decisions_per_sec": round(dps, 1)})
             print(f"K={K:4d} B={B:7d}: {per * 1e3:8.2f} ms/dispatch "
                   f"-> {dps:,.0f} decisions/s", flush=True)
         except Exception as e:  # noqa: BLE001 — keep probing other shapes
+            results.append({"K": K, "B": B, "error":
+                            f"{type(e).__name__}: {str(e)[:150]}"})
             print(f"K={K:4d} B={B:7d}: FAILED {type(e).__name__}: "
                   f"{str(e)[:150]}", flush=True)
+
+if JSON_OUT:
+    import json
+
+    ok = [r for r in results if "decisions_per_sec" in r
+          and np.isfinite(r["decisions_per_sec"])
+          and r["decisions_per_sec"] > 0]
+    best = max(ok, key=lambda r: r["decisions_per_sec"]) if ok else None
+    with open(JSON_OUT + ".tmp", "w") as f:
+        f.write(json.dumps({"backend": dev.platform, "points": results,
+                            "best": best}))
+    os.replace(JSON_OUT + ".tmp", JSON_OUT)
