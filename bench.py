@@ -732,9 +732,12 @@ def child_main():
         out = os.environ[OUT_ENV] + ".depth.json"
         proc = None
         try:
+            # tight timeout: a wedged probe must not starve the tiers
+            # (the wall budget also covers the init retries + tier
+            # compiles; tier checkpoints protect whatever completes)
             proc = subprocess.run(
                 [sys.executable, probe, "--quick", f"--json={out}"],
-                timeout=420, capture_output=True)
+                timeout=240, capture_output=True)
             with open(out) as f:
                 depth = json.loads(f.read())
             if depth.get("backend") == "cpu":
@@ -768,14 +771,16 @@ def child_main():
     tunnel_error = None
     try:
         try:
-            # probe-only first (fast wedge detection, chip left free),
-            # then the stack-depth subprocess (TPU runtimes are single-
-            # process-exclusive — it must run before jax initializes
-            # HERE), then the real in-process init
-            acquire_backend(init=False)
             if not os.environ.get("GUBER_BENCH_PLATFORM"):
+                # real-TPU path: probe-only wedge check (chip left free),
+                # then the stack-depth subprocess (TPU runtimes are
+                # single-process-exclusive — it must run before jax
+                # initializes HERE), then the full-retry in-process init
+                # (the kill-nudge attempts double as wedge recovery if
+                # the probe left the tunnel in a bad state)
+                acquire_backend(init=False)
                 pick_stack_depth(result)
-            devs = acquire_backend(attempts=2)
+            devs = acquire_backend()
         except RuntimeError as e:
             # tunnel wedged: fall back to CPU smoke tiers so the round
             # record carries real measurements, not a bare 0.0.  Tag the
