@@ -1,0 +1,20 @@
+"""Shared probe environment setup (import after the repo-root sys.path
+insert, call BEFORE any jax op): optional platform override for CPU smoke
+runs + the persistent compilation cache every probe and bench shares."""
+
+import os
+
+import jax
+
+
+def setup():
+    plat = os.environ.get("GUBER_PROBE_PLATFORM")
+    if plat:  # smoke runs force cpu; default = ambient (the tunnel chip)
+        jax.config.update("jax_platforms", plat)
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("GUBER_JAX_CACHE", "/root/repo/.jax_cache"))
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception:  # noqa: BLE001 — older jax: cache still works
+        pass

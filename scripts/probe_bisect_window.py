@@ -16,14 +16,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+from scripts._probe_env import setup as _setup
+_setup()
 
 from gubernator_tpu.ops import kernel
 from gubernator_tpu.ops.kernel import BucketState, _Reg, WindowOutput
 
-B = 32768
-C = 1 << 20
+B = int(os.environ.get("GUBER_PROBE_B", "32768"))
+C = int(os.environ.get("GUBER_PROBE_C", str(1 << 20)))
 now0 = 1_700_000_000_000
 rng = np.random.default_rng(5)
 print(f"# backend: {jax.devices()[0].platform}", file=sys.stderr, flush=True)

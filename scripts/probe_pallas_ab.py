@@ -22,14 +22,14 @@ import numpy as np
 
 import jax
 
-jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+from scripts._probe_env import setup as _setup
+_setup()
 
 from gubernator_tpu.core.engine import RateLimitEngine
 from gubernator_tpu.parallel.mesh import make_mesh
 
-B = 32768
-CAP = 1 << 20
+B = int(os.environ.get("GUBER_PROBE_B", "32768"))
+CAP = int(os.environ.get("GUBER_PROBE_C", str(1 << 20)))
 now0 = 1_700_000_000_000
 devs = jax.devices()
 mode = "pallas-compact32" if os.environ.get("GUBER_PALLAS") == "1" else "xla"
@@ -59,25 +59,33 @@ def stacked_time(k):
         host = np.asarray(words)
         ts.append(time.perf_counter() - t0)
     del eng
-    return float(np.percentile(np.array(ts[1:]) * 1e3, 50)), host
+    return float(np.percentile(np.array(ts[1:]) * 1e3, 50)), host, packed
 
 
-t1, w1 = stacked_time(1)
-t9, _ = stacked_time(9)
+t1, w1, packed1 = stacked_time(1)
+t9, _, _ = stacked_time(9)
 per = (t9 - t1) / 8
 print(f"{mode}: K=1 {t1:.2f}ms  K=9 {t9:.2f}ms  -> per-window {per:.2f}ms",
       flush=True)
 
-# functional spot check vs the host-side reference decode
+# Functional parity: replay the K=1 run's EXACT 8 windows through the
+# plain-XLA host kernel and require word-for-word equality with the
+# device's final fetch — under GUBER_PALLAS=1 this is the Pallas-vs-XLA
+# parity gate on real hardware.
+import jax.numpy as jnp  # noqa: E402
+
 from gubernator_tpu.ops import kernel  # noqa: E402
 
-state = kernel.BucketState.zeros(CAP)
-slots0 = ((rng.zipf(1.1, B) - 1) % CAP).astype(np.int32)
-batch = kernel.WindowBatch(
-    slot=slots0, hits=np.ones(B, np.int64),
-    limit=np.full(B, 1_000_000, np.int64),
-    duration=np.full(B, 600_000, np.int64),
-    algo=np.zeros(B, np.int32), is_init=np.ones(B, bool))
-_, want = kernel.window_step(state, batch, now0)
-print(f"sanity: first-window fetch shape {w1.shape}, "
-      f"nonzero words {int((w1 != 0).sum())}", flush=True)
+st = kernel.BucketState.zeros(CAP)
+bt = kernel.decode_batch(jnp.asarray(packed1[0, 0]))
+for rep in range(8):
+    st, out = kernel.window_step(st, bt, jnp.int64(now0 + rep))
+ref = np.asarray(kernel.encode_output_word(out, jnp.int64(now0 + 7)))
+assert w1.shape[-1] == ref.shape[-1], (w1.shape, ref.shape)
+match = np.array_equal(w1[0, 0], ref)
+print(f"parity vs host XLA kernel over 8 replayed windows: "
+      f"{'EXACT' if match else 'MISMATCH'} "
+      f"({int((w1[0, 0] != ref).sum())} differing words of {B})",
+      flush=True)
+if not match:
+    sys.exit(1)

@@ -24,13 +24,8 @@ import numpy as np
 
 import jax
 
-_plat = os.environ.get("GUBER_PROBE_PLATFORM")
-if _plat:  # smoke runs force cpu; default = ambient (the tunnel chip)
-    jax.config.update("jax_platforms", _plat)
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("GUBER_JAX_CACHE",
-                                 "/root/repo/.jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+from scripts._probe_env import setup as _setup
+_setup()
 
 from gubernator_tpu.ops import kernel  # noqa: E402
 from gubernator_tpu.ops.kernel import BucketState  # noqa: E402
