@@ -1,0 +1,48 @@
+#!/bin/bash
+# The full on-chip measurement session, runnable unattended the moment the
+# tunnel heals (tunnel_watch.sh triggers it once on ALIVE).  Order matters:
+# decisive cheap probes first (the tunnel historically wedges again within
+# ~2h), full bench last.  Everything appends to /tmp/tunnel_session.log and
+# results land in /root/repo/TPU_SESSION_r5/.
+set -u
+cd /root/repo
+OUT=/root/repo/TPU_SESSION_r5
+mkdir -p "$OUT"
+LOG="$OUT/session.log"
+exec >>"$LOG" 2>&1
+echo "=== tunnel session start $(date -u +%FT%TZ) ==="
+
+run() { # name timeout cmd...
+  local name=$1 to=$2; shift 2
+  echo "--- $name ($(date -u +%T)) ---"
+  timeout "$to" "$@" > "$OUT/$name.out" 2>&1
+  local rc=$?
+  echo "$name rc=$rc"
+  tail -20 "$OUT/$name.out"
+  return $rc
+}
+
+# 1. stage bisect of the composed window cost (the round-4 mystery)
+run bisect 900 python scripts/probe_bisect_window.py
+
+# 2. XLA vs Pallas-compact32 A/B with the word-exact parity gate
+run pallas_xla 900 python scripts/probe_pallas_ab.py
+run pallas_mosaic 900 env GUBER_PALLAS=1 python scripts/probe_pallas_ab.py
+
+# 3. decisions-per-dispatch surface (full grid)
+run stack_depth 1500 python scripts/probe_stack_depth.py \
+    --json="$OUT/stack_depth.json"
+
+# 4. GUBER_PALLAS=1 core-suite certification on the real chip
+#    (tests force the cpu platform via conftest; the on-chip answer comes
+#    from the serving engine, so run the kernel differentials with the
+#    platform left ambient through a dedicated driver)
+run pallas_kernel_onchip 900 env GUBER_PALLAS=1 GUBER_PROBE_B=4096 \
+    python scripts/probe_pallas_ab.py
+
+# 5. the full driver bench (stack-depth quick probe runs inside it and
+#    sets the serving K; tier checkpoints persist to
+#    BENCH_TPU_CHECKPOINT.json as they complete)
+run bench 1300 python bench.py
+
+echo "=== tunnel session end $(date -u +%FT%TZ) ==="

@@ -15,6 +15,14 @@ print('ALIVE', d[0].platform, x, flush=True)
   if grep -q ALIVE /tmp/tunnel_probe.out; then
     date -u +"%Y-%m-%dT%H:%M:%SZ alive" >> /tmp/TUNNEL_ALIVE
     echo "tunnel ALIVE at $(date -u)"
+    # fire the full measurement session ONCE per heal (decisive probes
+    # first — the tunnel historically re-wedges within ~2h)
+    if [ ! -f /tmp/TUNNEL_SESSION_STARTED ]; then
+      touch /tmp/TUNNEL_SESSION_STARTED
+      setsid nohup bash /root/repo/scripts/tunnel_session.sh \
+        > /tmp/tunnel_session_launch.log 2>&1 &
+      echo "tunnel session launched"
+    fi
   else
     rm -f /tmp/TUNNEL_ALIVE
     echo "tunnel dead at $(date -u)"
