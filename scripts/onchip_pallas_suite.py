@@ -1,0 +1,130 @@
+"""On-chip Pallas-compact32 certification: randomized kernel differential.
+
+The pytest suite forces the CPU platform (conftest), so GUBER_PALLAS=1
+there only certifies interpret mode.  This driver runs the same style of
+randomized differential ON THE AMBIENT BACKEND (the tunnel chip): many
+randomized compact windows — mixed algorithms, hits 0..n (read-only,
+partial, exact-drain, over-ask), duplicate-key runs (fold + replay),
+init and non-init lanes, expiry boundaries — dispatched through the real
+serving drain executable, each compared word-for-word against the plain
+XLA host kernel replaying the identical inputs.
+
+Exit 0 = every window word-exact (the GUBER_PALLAS=1 on-chip answer);
+nonzero = mismatch, with the first differing window dumped.
+
+Run:  GUBER_PALLAS=1 python scripts/onchip_pallas_suite.py
+(and once without GUBER_PALLAS for the XLA control run).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+from scripts._probe_env import setup as _setup
+
+_setup()
+
+import jax.numpy as jnp  # noqa: E402
+
+from gubernator_tpu.core.engine import _compiled_pipeline_step  # noqa: E402
+from gubernator_tpu.ops import kernel  # noqa: E402
+from gubernator_tpu.ops.kernel import BucketState  # noqa: E402
+from gubernator_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+B = int(os.environ.get("GUBER_PROBE_B", "1024"))
+C = int(os.environ.get("GUBER_PROBE_C", str(1 << 16)))
+SEEDS = int(os.environ.get("GUBER_PROBE_SEEDS", "6"))
+WINDOWS = int(os.environ.get("GUBER_PROBE_WINDOWS", "8"))
+now0 = 1_700_000_000_000
+
+dev = jax.devices()[0]
+mode = ("pallas-compact32" if os.environ.get("GUBER_PALLAS") == "1"
+        else "xla")
+print(f"# backend: {dev.platform}  mode: {mode}  "
+      f"B={B} C={C} seeds={SEEDS} windows={WINDOWS}", flush=True)
+
+mesh = make_mesh(jax.devices()[:1])
+fn = _compiled_pipeline_step(mesh)
+
+
+def random_window(rng, hot):
+    """One compact window of B lanes with the full branch mix."""
+    n = int(rng.integers(B // 2, B + 1))
+    slot = np.zeros(B, np.int64)
+    hits = np.zeros(B, np.int64)
+    limit = np.zeros(B, np.int64)
+    duration = np.zeros(B, np.int64)
+    algo = np.zeros(B, np.int64)
+    is_init = np.zeros(B, np.int64)
+    i = 0
+    while i < n:
+        if rng.random() < 0.3:  # duplicate-key run (uniform or mixed)
+            run_len = min(int(rng.integers(2, 12)), n - i)
+            s = int(hot[rng.integers(0, len(hot))])
+            uniform = rng.random() < 0.5
+            for j in range(run_len):
+                slot[i] = s
+                hits[i] = 1 if uniform else int(rng.integers(0, 5))
+                limit[i] = 10 if uniform else int(rng.integers(1, 50))
+                duration[i] = 60_000
+                algo[i] = 0 if uniform else int(rng.integers(0, 2))
+                is_init[i] = 1 if (j == 0 and rng.random() < 0.5) else 0
+                i += 1
+        else:
+            slot[i] = int(rng.integers(0, C))
+            hits[i] = int(rng.integers(0, 6))
+            limit[i] = int(rng.integers(1, 1_000_000))
+            duration[i] = int(rng.integers(1, 600_000))
+            algo[i] = int(rng.integers(0, 2))
+            is_init[i] = int(rng.integers(0, 2))
+            i += 1
+    pk = np.zeros((1, B, 2), np.int64)
+    occ = np.arange(B) < n
+    pk[0, :, 0] = np.where(
+        occ, (slot + 1) | (is_init << 32) | (algo << 33) | (hits << 34), 0)
+    pk[0, :, 1] = np.where(occ, limit | (duration << 32), 0)
+    return pk
+
+
+fails = 0
+checked = 0
+t_start = time.time()
+for seed in range(SEEDS):
+    rng = np.random.default_rng(7000 + seed)
+    hot = rng.integers(0, C, size=6)
+    # device side: one engine state chained across WINDOWS drains
+    dstate = BucketState(*[jax.device_put(np.asarray(a)[None])
+                           for a in BucketState.zeros(C)])
+    # host side: plain XLA kernel replay of the identical inputs
+    hstate = kernel.BucketState.zeros(C)
+    for w in range(WINDOWS):
+        pk = random_window(rng, hot)
+        now = now0 + w * int(rng.integers(1, 30_000))
+        dstate, words, limits, mism = fn(
+            dstate, jax.device_put(pk[None]),
+            jax.device_put(np.full(1, now, np.int64)))
+        got = np.asarray(words)[0, 0]
+        bt = kernel.decode_batch(jnp.asarray(pk[0]))
+        hstate, out = kernel.window_step(hstate, bt, jnp.int64(now))
+        want = np.asarray(kernel.encode_output_word(out, jnp.int64(now)))
+        checked += 1
+        if not np.array_equal(got, want):
+            fails += 1
+            d = np.flatnonzero(got != want)
+            print(f"MISMATCH seed={seed} window={w}: {len(d)} lanes, "
+                  f"first lane {d[0]}: got={got[d[0]]:#x} "
+                  f"want={want[d[0]]:#x} pk={pk[0, d[0]]}", flush=True)
+            if fails >= 3:
+                break
+    if fails >= 3:
+        break
+
+verdict = "CERTIFIED word-exact" if fails == 0 else f"{fails} MISMATCHES"
+print(f"{mode} on {dev.platform}: {checked} randomized windows, {verdict} "
+      f"({time.time() - t_start:.0f}s)", flush=True)
+sys.exit(0 if fails == 0 else 1)

@@ -1,9 +1,9 @@
 #!/bin/bash
 # The full on-chip measurement session, runnable unattended the moment the
-# tunnel heals (tunnel_watch.sh triggers it once on ALIVE).  Order matters:
+# tunnel heals (tunnel_watch.sh triggers it once per heal).  Order matters:
 # decisive cheap probes first (the tunnel historically wedges again within
-# ~2h), full bench last.  Everything appends to /tmp/tunnel_session.log and
-# results land in /root/repo/TPU_SESSION_r5/.
+# ~2h), full bench last.  All output lands under /root/repo/TPU_SESSION_r5/
+# (session.log + one .out per step).
 set -u
 cd /root/repo
 OUT=/root/repo/TPU_SESSION_r5
@@ -33,12 +33,14 @@ run pallas_mosaic 900 env GUBER_PALLAS=1 python scripts/probe_pallas_ab.py
 run stack_depth 1500 python scripts/probe_stack_depth.py \
     --json="$OUT/stack_depth.json"
 
-# 4. GUBER_PALLAS=1 core-suite certification on the real chip
-#    (tests force the cpu platform via conftest; the on-chip answer comes
-#    from the serving engine, so run the kernel differentials with the
-#    platform left ambient through a dedicated driver)
-run pallas_kernel_onchip 900 env GUBER_PALLAS=1 GUBER_PROBE_B=4096 \
-    python scripts/probe_pallas_ab.py
+# 4. GUBER_PALLAS=1 certification on the real chip: randomized kernel
+#    differential on the ambient backend (the pytest suite pins the cpu
+#    platform, so this dedicated driver is the on-chip answer) — full
+#    branch mix, word-exact vs the XLA host kernel, exit nonzero on any
+#    mismatch
+run pallas_cert_onchip 1200 env GUBER_PALLAS=1 \
+    python scripts/onchip_pallas_suite.py
+run xla_cert_onchip 1200 python scripts/onchip_pallas_suite.py
 
 # 5. the full driver bench (stack-depth quick probe runs inside it and
 #    sets the serving K; tier checkpoints persist to

@@ -25,6 +25,14 @@ print('ALIVE', d[0].platform, x, flush=True)
     fi
   else
     rm -f /tmp/TUNNEL_ALIVE
+    # re-arm the session trigger for the NEXT heal — but never while a
+    # session is still running (a transient probe failure mid-session
+    # must not queue a second overlapping session)
+    if [ -f /tmp/TUNNEL_SESSION_STARTED ] && \
+       ! pgrep -f tunnel_session.sh >/dev/null; then
+      rm -f /tmp/TUNNEL_SESSION_STARTED
+      echo "session trigger re-armed"
+    fi
     echo "tunnel dead at $(date -u)"
   fi
   sleep 240
