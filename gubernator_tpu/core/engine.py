@@ -1442,7 +1442,17 @@ def _use_pallas() -> bool:
     return os.environ.get("GUBER_PALLAS") == "1"
 
 
-def _window_step_fn(mesh: Mesh, compact32: bool, pallas: bool):
+def _use_compact32_xla() -> bool:
+    """Default-on rebased-int32 XLA math for compact call sites
+    (GUBER_COMPACT32_XLA=0 reverts to the int64 kernel).  Same read-at-
+    build-time discipline as _use_pallas: the flag is part of each
+    compiled builder's cache key, never read mid-trace."""
+    import os
+    return os.environ.get("GUBER_COMPACT32_XLA", "1") == "1"
+
+
+def _window_step_fn(mesh: Mesh, compact32: bool, pallas: bool,
+                    c32xla: bool):
     """kernel.window_step, or its Pallas lowering under GUBER_PALLAS=1
     (interpret mode when the MESH's devices are CPU — Mosaic is TPU-only,
     and the process default backend may differ from the mesh platform).
@@ -1450,14 +1460,18 @@ def _window_step_fn(mesh: Mesh, compact32: bool, pallas: bool):
     compact32 marks call sites whose lanes are guaranteed inside the
     compact wire-format ranges (the pipeline drain): there the Pallas
     kernel runs in rebased int32, which is the ONLY form Mosaic accepts
-    on real TPU (no 64-bit vector types).  Full-format call sites on a
-    TPU mesh keep the XLA path — an int64 Pallas kernel cannot lower.
+    on real TPU (no 64-bit vector types).  Without Pallas those call
+    sites run the SAME rebased-int32 math as plain XLA by default
+    (window_step_compact32_xla, c32xla): TPU XLA emulates int64
+    arithmetic as i32-pair ops, so the int64 ladder pays roughly double
+    the math op count for no benefit inside the compact ranges.
+    Full-format call sites keep the int64 kernel — their lanes can
+    exceed the rebase range.
 
-    `pallas` is REQUIRED and threaded from the compiled-builder cache
-    key so a jit object built under one GUBER_PALLAS setting cannot
-    trace under another (the builders cache per (mesh, pallas)); an
-    env-reading default here would reintroduce the trace-time read the
-    cache key exists to eliminate."""
+    `pallas`/`c32xla` are REQUIRED and threaded from the compiled-builder
+    cache keys so a jit object built under one env setting cannot trace
+    under another; an env-reading default here would reintroduce the
+    trace-time read the cache keys exist to eliminate."""
     if pallas:
         from functools import partial
 
@@ -1469,6 +1483,11 @@ def _window_step_fn(mesh: Mesh, compact32: bool, pallas: bool):
         if on_cpu:
             return partial(window_step_pallas, interpret=True)
         return kernel.window_step
+    if compact32 and c32xla:
+        from gubernator_tpu.ops.pallas_kernel import (
+            window_step_compact32_xla,
+        )
+        return window_step_compact32_xla
     return kernel.window_step
 
 
@@ -1550,7 +1569,8 @@ def _compiled_step_impl(mesh: Mesh, pallas: bool):
             # gstate/gcfg [G] (replicated); upd/ups [K*] (replicated).
             st = BucketState(*jax.tree.map(lambda a: a[0], state))
             bt = WindowBatch(*jax.tree.map(lambda a: a[0], batch))
-            new_st, out = _window_step_fn(mesh, compact32=False, pallas=pallas)(st, bt, now)
+            new_st, out = _window_step_fn(mesh, compact32=False, pallas=pallas,
+                                      c32xla=False)(st, bt, now)
 
             gstate, gcfg = _apply_control(gstate, gcfg, upd, ups)
             gb = WindowBatch(*jax.tree.map(lambda a: a[0], gbatch))
@@ -1595,11 +1615,13 @@ def _compiled_step_impl(mesh: Mesh, pallas: bool):
 
 
 def _compiled_step_compact(mesh: Mesh):
-    return _compiled_step_compact_impl(mesh, _use_pallas())
+    return _compiled_step_compact_impl(mesh, _use_pallas(),
+                                       _use_compact32_xla())
 
 
 @lru_cache(maxsize=None)
-def _compiled_step_compact_impl(mesh: Mesh, pallas: bool):
+def _compiled_step_compact_impl(mesh: Mesh, pallas: bool,
+                                c32xla: bool):
     """The serving fast path: compact request/response wire format.
 
     Same computation as _compiled_step, but the regular-key window crosses
@@ -1612,7 +1634,8 @@ def _compiled_step_compact_impl(mesh: Mesh, pallas: bool):
     def shard_fn(state, gstate, gcfg, packed, gbatch, gacc, upd, ups, now):
         st = BucketState(*jax.tree.map(lambda a: a[0], state))
         bt = kernel.decode_batch(packed[0])
-        new_st, out = _window_step_fn(mesh, compact32=True, pallas=pallas)(st, bt, now)
+        new_st, out = _window_step_fn(mesh, compact32=True, pallas=pallas,
+                                      c32xla=c32xla)(st, bt, now)
 
         gstate, gcfg = _apply_control(gstate, gcfg, upd, ups)
         gb = WindowBatch(*jax.tree.map(lambda a: a[0], gbatch))
@@ -1690,11 +1713,13 @@ def _compiled_global_register(mesh: Mesh):
 
 
 def _compiled_pipeline_step(mesh: Mesh):
-    return _compiled_pipeline_step_impl(mesh, _use_pallas())
+    return _compiled_pipeline_step_impl(mesh, _use_pallas(),
+                                        _use_compact32_xla())
 
 
 @lru_cache(maxsize=None)
-def _compiled_pipeline_step_impl(mesh: Mesh, pallas: bool):
+def _compiled_pipeline_step_impl(mesh: Mesh, pallas: bool,
+                                 c32xla: bool):
     """K compact serving windows in ONE device dispatch — the drain
     executable of the serving pipeline (core/pipeline.py).
 
@@ -1724,7 +1749,8 @@ def _compiled_pipeline_step_impl(mesh: Mesh, pallas: bool):
         def body(st, xs):
             pk, now = xs
             bt = kernel.decode_batch(pk[0])
-            st, out = _window_step_fn(mesh, compact32=True, pallas=pallas)(st, bt, now)
+            st, out = _window_step_fn(mesh, compact32=True, pallas=pallas,
+                                      c32xla=c32xla)(st, bt, now)
             word = kernel.encode_output_word(out, now)
             mism = jnp.any((out.limit != bt.limit) & (bt.slot >= 0))
             return st, (word, out.limit, mism)
@@ -1784,7 +1810,8 @@ def _compiled_multi_step_impl(mesh: Mesh, pallas: bool):
             st, gst = carry
             b, gb, gacc, now = xs
             bt = WindowBatch(*jax.tree.map(lambda a: a[0], b))
-            st, out = _window_step_fn(mesh, compact32=False, pallas=pallas)(st, bt, now)
+            st, out = _window_step_fn(mesh, compact32=False, pallas=pallas,
+                                      c32xla=False)(st, bt, now)
             gbt = WindowBatch(*jax.tree.map(lambda a: a[0], gb))
             gst, gout = _global_window(gst, gcfg, gbt, gacc[0], now, mesh, pallas)
             return (st, gst), kernel.pack_outputs(out, gout)

@@ -117,38 +117,35 @@ def global_apply_pallas(state: BucketState, cfg: GlobalConfig,
 # ---- the serving window kernel ------------------------------------------
 
 
-def _window_math_kernel(now_ref, maxpos_ref,
-                        s_valid, s_hits, s_limit, s_duration, s_algo,
-                        s_init, s_agg, pos, seg_len, seg_start_idx,
-                        seg_uniform, h0, l0, d0, a0, fresh_seg,
-                        r_lim, r_dur, r_rem, r_ts, r_exp, r_algo,
-                        o_status, o_limit, o_rem, o_reset,
-                        f_lim, f_dur, f_rem, f_ts, f_exp, f_algo):
-    """One VMEM pass over the sorted window: closed-form uniform segments,
-    then replay rounds for irregular ones.
+def _window_math(now, max_pos, s_valid, s_hits, s_limit, s_duration,
+                 s_algo, s_agg, pos, seg_len, seg_start_idx, seg_uniform,
+                 h0, l0, d0, a0, fresh_seg, reg):
+    """One pass over the sorted window: closed-form uniform segments, then
+    replay rounds for irregular ones.  Pure function of [B] lane vectors —
+    the SAME body runs as a Pallas VMEM kernel (via _window_math_kernel)
+    and as plain traced XLA (window_step_compact(..., use_pallas=False)),
+    in either int64 or rebased-int32 form.
 
     Register state is REPLICATED at every lane of its segment (the arena
     gather outside already yields that: all lanes of a segment load the
     same slot), so a replay round is elementwise plus ONE vector gather —
     `computed[seg_start + p]` pulls the active lane's freshly-computed
     register back to every lane of its segment — with no scatters.
-    """
-    now = now_ref[0]
-    max_pos = maxpos_ref[0]
-    B = pos.shape[0]
 
-    reg = _Reg(limit=r_lim[:], duration=r_dur[:], remaining=r_rem[:],
-               tstamp=r_ts[:], expire=r_exp[:], algo=r_algo[:])
-    fresh0 = fresh_seg[:]
-    uniform = seg_uniform[:]
-    valid = s_valid[:]
-    p_arr = pos[:]
-    sidx = seg_start_idx[:]
+    Returns (out_sorted: WindowOutput, fin: _Reg) with fin already
+    uniform-vs-replayed selected.
+    """
+    B = pos.shape[0]
+    fresh0 = fresh_seg
+    uniform = seg_uniform
+    valid = s_valid
+    p_arr = pos
+    sidx = seg_start_idx
 
     # ---- closed form for uniform segments (replicated-register form) ----
     ff_reg, ff_out = kernel.uniform_closed_form(
-        reg, fresh0 | (a0[:] != reg.algo), h0[:], l0[:], d0[:], a0[:],
-        p_arr, seg_len[:], now)
+        reg, fresh0 | (a0 != reg.algo), h0, l0, d0, a0,
+        p_arr, seg_len, now)
 
     # ---- replay rounds for irregular segments ----
     def body(carry):
@@ -158,10 +155,10 @@ def _window_math_kernel(now_ref, maxpos_ref,
         # is_init lanes start their own virtual segment, so their
         # freshness is carried by fr (fresh_seg) until their round clears
         # it — no per-lane s_init term needed
-        fresh = fr | (s_algo[:] != r.algo)
+        fresh = fr | (s_algo != r.algo)
         new_r, resp = kernel.transition(
-            r, s_hits[:], s_limit[:], s_duration[:], s_algo[:], now, fresh,
-            agg=s_agg[:])
+            r, s_hits, s_limit, s_duration, s_algo, now, fresh,
+            agg=s_agg)
         active = (p_arr == p) & valid & ~uniform
         # Propagate the active lane's result to its WHOLE segment (the
         # final commit reads registers at segment-start lanes, pos 0).
@@ -196,25 +193,61 @@ def _window_math_kernel(now_ref, maxpos_ref,
     carry = lax.while_loop(lambda c: c[0] <= max_pos, body, init)
     (_, lim, dur, rem, ts, exp, alg, _, ost, oli, ore, ors) = carry
 
-    o_status[:] = ost
-    o_limit[:] = oli
-    o_rem[:] = ore
-    o_reset[:] = ors
-    f_lim[:] = jnp.where(uniform, ff_reg.limit, lim)
-    f_dur[:] = jnp.where(uniform, ff_reg.duration, dur)
-    f_rem[:] = jnp.where(uniform, ff_reg.remaining, rem)
-    f_ts[:] = jnp.where(uniform, ff_reg.tstamp, ts)
-    f_exp[:] = jnp.where(uniform, ff_reg.expire, exp)
-    f_algo[:] = jnp.where(uniform, ff_reg.algo, alg)
+    out_sorted = WindowOutput(status=ost, limit=oli, remaining=ore,
+                              reset_time=ors)
+    fin = _Reg(
+        limit=jnp.where(uniform, ff_reg.limit, lim),
+        duration=jnp.where(uniform, ff_reg.duration, dur),
+        remaining=jnp.where(uniform, ff_reg.remaining, rem),
+        tstamp=jnp.where(uniform, ff_reg.tstamp, ts),
+        expire=jnp.where(uniform, ff_reg.expire, exp),
+        algo=jnp.where(uniform, ff_reg.algo, alg))
+    return out_sorted, fin
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "compact32"))
+def _window_math_kernel(now_ref, maxpos_ref,
+                        s_valid, s_hits, s_limit, s_duration, s_algo,
+                        s_init, s_agg, pos, seg_len, seg_start_idx,
+                        seg_uniform, h0, l0, d0, a0, fresh_seg,
+                        r_lim, r_dur, r_rem, r_ts, r_exp, r_algo,
+                        o_status, o_limit, o_rem, o_reset,
+                        f_lim, f_dur, f_rem, f_ts, f_exp, f_algo):
+    """Pallas Ref wrapper around _window_math (reads refs, writes refs)."""
+    reg = _Reg(limit=r_lim[:], duration=r_dur[:], remaining=r_rem[:],
+               tstamp=r_ts[:], expire=r_exp[:], algo=r_algo[:])
+    out_sorted, fin = _window_math(
+        now_ref[0], maxpos_ref[0], s_valid[:], s_hits[:], s_limit[:],
+        s_duration[:], s_algo[:], s_agg[:], pos[:], seg_len[:],
+        seg_start_idx[:], seg_uniform[:], h0[:], l0[:], d0[:], a0[:],
+        fresh_seg[:], reg)
+    o_status[:] = out_sorted.status
+    o_limit[:] = out_sorted.limit
+    o_rem[:] = out_sorted.remaining
+    o_reset[:] = out_sorted.reset_time
+    f_lim[:] = fin.limit
+    f_dur[:] = fin.duration
+    f_rem[:] = fin.remaining
+    f_ts[:] = fin.tstamp
+    f_exp[:] = fin.expire
+    f_algo[:] = fin.algo
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("interpret", "compact32", "use_pallas"))
 def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
-                       interpret: bool = False, compact32: bool = False
+                       interpret: bool = False, compact32: bool = False,
+                       use_pallas: bool = True
                        ) -> tuple[BucketState, WindowOutput]:
     """Drop-in replacement for kernel.window_step with the window math in
     one Pallas kernel.  Sort, segment indexing, the arena gather, and the
     final scatter/unsort stay in XLA (see the module docstring for why).
+
+    use_pallas=False runs the IDENTICAL math (_window_math, same rebase
+    and re-absolutize) as plain traced XLA — with compact32=True that is
+    the engine's default serving form (window_step_compact32_xla below):
+    int64 arithmetic on TPU lowers to multi-op i32-pair emulation, so
+    running the ladder in rebased int32 roughly halves the math's op
+    count even without Mosaic.
 
     compact32=True runs the kernel body entirely in int32 with times
     REBASED to the window's `now` — Mosaic on real TPU has no 64-bit
@@ -267,28 +300,34 @@ def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
     # check_vma on its shard_maps when Pallas is enabled (vma tags do not
     # survive the kernel's interpret-mode while_loop), in which case typeof
     # has no vma and None is correct.
-    vma = getattr(jax.typeof(batch.slot), "vma", None)
-    sds = lambda dt: jax.ShapeDtypeStruct((B,), dt, vma=vma)
-    spec = pl.BlockSpec((B,), lambda: (0,))
-    sspec = pl.BlockSpec((1,), lambda: (0,))
-    outs = pl.pallas_call(
-        _window_math_kernel,
-        in_specs=[sspec, sspec] + [spec] * 22,
-        out_specs=[spec] * 10,
-        out_shape=[sds(I32), sds(VD), sds(VD), sds(VD),   # outputs
-                   sds(VD), sds(VD), sds(VD), sds(VD), sds(VD),
-                   sds(I32)],                             # final regs
-        interpret=interpret,
-    )(k_now, max_pos.reshape((1,)),
-      s_valid, k_hits, k_limit, k_dur, s_algo, s_init, s_agg,
-      pos, seg_len, seg_start_idx, seg_uniform,
-      k_h0, k_l0, k_d0, a0, fresh_seg,
-      k_cur.limit, k_cur.duration, k_cur.remaining, k_cur.tstamp,
-      k_cur.expire, k_cur.algo)
-    out_sorted = WindowOutput(status=outs[0], limit=outs[1],
-                              remaining=outs[2], reset_time=outs[3])
-    fin = _Reg(limit=outs[4], duration=outs[5], remaining=outs[6],
-               tstamp=outs[7], expire=outs[8], algo=outs[9])
+    if use_pallas:
+        vma = getattr(jax.typeof(batch.slot), "vma", None)
+        sds = lambda dt: jax.ShapeDtypeStruct((B,), dt, vma=vma)
+        spec = pl.BlockSpec((B,), lambda: (0,))
+        sspec = pl.BlockSpec((1,), lambda: (0,))
+        outs = pl.pallas_call(
+            _window_math_kernel,
+            in_specs=[sspec, sspec] + [spec] * 22,
+            out_specs=[spec] * 10,
+            out_shape=[sds(I32), sds(VD), sds(VD), sds(VD),   # outputs
+                       sds(VD), sds(VD), sds(VD), sds(VD), sds(VD),
+                       sds(I32)],                             # final regs
+            interpret=interpret,
+        )(k_now, max_pos.reshape((1,)),
+          s_valid, k_hits, k_limit, k_dur, s_algo, s_init, s_agg,
+          pos, seg_len, seg_start_idx, seg_uniform,
+          k_h0, k_l0, k_d0, a0, fresh_seg,
+          k_cur.limit, k_cur.duration, k_cur.remaining, k_cur.tstamp,
+          k_cur.expire, k_cur.algo)
+        out_sorted = WindowOutput(status=outs[0], limit=outs[1],
+                                  remaining=outs[2], reset_time=outs[3])
+        fin = _Reg(limit=outs[4], duration=outs[5], remaining=outs[6],
+                   tstamp=outs[7], expire=outs[8], algo=outs[9])
+    else:
+        out_sorted, fin = _window_math(
+            k_now[0], max_pos, s_valid, k_hits, k_limit, k_dur, s_algo,
+            s_agg, pos, seg_len, seg_start_idx, seg_uniform,
+            k_h0, k_l0, k_d0, a0, fresh_seg, k_cur)
     if compact32:
         # re-absolutize.  reset_time: leaky uses 0 as the "no reset"
         # sentinel and every leaky non-zero reset is now+rate with
@@ -309,3 +348,14 @@ def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
                    expire=fin.expire.astype(I64) + now,
                    algo=fin.algo)
     return kernel.window_commit(state, prep, fin, out_sorted)
+
+
+def window_step_compact32_xla(state: BucketState, batch: WindowBatch, now
+                              ) -> tuple[BucketState, WindowOutput]:
+    """The serving drain's default window step: the rebased-int32 math as
+    plain traced XLA (no Mosaic dependency).  Exact under the compact
+    wire-format range caps — the only context the engine calls it in
+    (see window_step_pallas's compact32 notes for the rebase identities).
+    """
+    return window_step_pallas(state, batch, now, compact32=True,
+                              use_pallas=False)

@@ -32,7 +32,12 @@ B = int(os.environ.get("GUBER_PROBE_B", "32768"))
 CAP = int(os.environ.get("GUBER_PROBE_C", str(1 << 20)))
 now0 = 1_700_000_000_000
 devs = jax.devices()
-mode = "pallas-compact32" if os.environ.get("GUBER_PALLAS") == "1" else "xla"
+if os.environ.get("GUBER_PALLAS") == "1":
+    mode = "pallas-compact32"
+elif os.environ.get("GUBER_COMPACT32_XLA", "1") == "1":
+    mode = "xla-compact32"
+else:
+    mode = "xla-int64"
 print(f"# backend: {devs[0].platform}  mode: {mode}", file=sys.stderr,
       flush=True)
 mesh = make_mesh(devs[:1])

@@ -25,8 +25,11 @@ run() { # name timeout cmd...
 # 1. stage bisect of the composed window cost (the round-4 mystery)
 run bisect 900 python scripts/probe_bisect_window.py
 
-# 2. XLA vs Pallas-compact32 A/B with the word-exact parity gate
-run pallas_xla 900 python scripts/probe_pallas_ab.py
+# 2. three-way window-math A/B with the word-exact parity gate:
+#    int64 XLA (the round-4 form), compact32-XLA (the new default — the
+#    i64-emulation hypothesis's direct test), Pallas-compact32 (Mosaic)
+run xla_int64 900 env GUBER_COMPACT32_XLA=0 python scripts/probe_pallas_ab.py
+run xla_compact32 900 python scripts/probe_pallas_ab.py
 run pallas_mosaic 900 env GUBER_PALLAS=1 python scripts/probe_pallas_ab.py
 
 # 3. decisions-per-dispatch surface (full grid)

@@ -184,6 +184,51 @@ def test_pallas_compact32_matches_xla(seed):
                 np.asarray(x), np.asarray(p), err_msg=f"window {w} state.{name}")
 
 
+@pytest.mark.parametrize("seed", [0, 1])
+def test_compact32_xla_matches_int64(seed):
+    """window_step_compact32_xla — the serving drain's DEFAULT math
+    (rebased int32 as plain XLA, no Mosaic) — must be bit-exact with the
+    int64 kernel on the same compact-range workloads that pin the Pallas
+    form (same rebase, same re-absolutize, so one differential guards
+    both)."""
+    from gubernator_tpu.ops.pallas_kernel import window_step_compact32_xla
+
+    rng = np.random.default_rng(180 + seed)
+    B, C = 128, 32
+    state_x = kernel.BucketState.zeros(C)
+    state_c = kernel.BucketState.zeros(C)
+    big_l = int(kernel.COMPACT_MAX_LIMIT - 1)
+    big_d = int(kernel.COMPACT_MAX_DURATION - 1)
+    big_h = int(kernel.COMPACT_MAX_HITS - 1)
+    now = T0
+    for w in range(6):
+        now += int(rng.integers(1, 400))
+        batch = _random_window(rng, B, C)
+        capped = rng.random(B) < 0.2
+        batch = kernel.WindowBatch(
+            slot=batch.slot,
+            hits=jnp.where(jnp.asarray(rng.random(B) < 0.1),
+                           jnp.int64(big_h), batch.hits),
+            limit=jnp.where(jnp.asarray(capped), jnp.int64(big_l),
+                            batch.limit),
+            duration=jnp.where(jnp.asarray(capped), jnp.int64(big_d),
+                               batch.duration),
+            algo=batch.algo,
+            is_init=batch.is_init,
+        )
+        state_x, out_x = kernel.window_step(state_x, batch, now)
+        state_c, out_c = window_step_compact32_xla(state_c, batch, now)
+        valid = np.asarray(batch.slot) >= 0
+        for name, x, c in zip(kernel.WindowOutput._fields, out_x, out_c):
+            np.testing.assert_array_equal(
+                np.asarray(x)[valid], np.asarray(c)[valid],
+                err_msg=f"window {w} out.{name}")
+        for name, x, c in zip(kernel.BucketState._fields, state_x, state_c):
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(c),
+                err_msg=f"window {w} state.{name}")
+
+
 def test_engine_compact_serving_uses_compact32(monkeypatch):
     """Under GUBER_PALLAS=1 the engine's compact serving path (pipeline
     drain) runs the i32 kernel; responses must match a plain engine."""
