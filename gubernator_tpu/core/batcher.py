@@ -77,8 +77,7 @@ class WindowBatcher:
             # eligible submits would hang forever
             raise ValueError("a multiprocess (mesh) engine needs a "
                              "lockstep_clock-driven WindowBatcher")
-        self.pipeline: Optional[DispatchPipeline] = None
-        self.pipeline = DispatchPipeline(
+        self.pipeline: Optional[DispatchPipeline] = DispatchPipeline(
             engine, self._executor, metrics,
             lockstep=lockstep_clock is not None)
         if not self.pipeline.enabled:
@@ -88,6 +87,10 @@ class WindowBatcher:
             self.pipeline.legacy = self._legacy_lockstep
         else:
             self.pipeline.legacy = self._legacy_process
+            # submit-side coalescing window = the configured BatchWait
+            # (the reference's knob, config.go:60-62) — not a hardcoded
+            # twin of its default
+            self.pipeline.coalesce_wait = self.behaviors.batch_wait
 
     async def _legacy_process(self, reqs: Sequence[RateLimitReq]
                               ) -> List[RateLimitResp]:

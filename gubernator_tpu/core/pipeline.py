@@ -374,6 +374,8 @@ class DispatchPipeline:
         # fetch pool on near-empty drains (round-4 thundering-herd p99).
         # Saturated mode is unaffected: completion callbacks pump with
         # force=True, so at depth the cadence is completion-driven.
+        # The batcher overrides coalesce_wait with the configured
+        # BehaviorConfig.batch_wait (this default mirrors its default).
         self.coalesce_wait = 0.0005
         self.coalesce_min = MAX_BATCH_SIZE  # decisions that skip the wait
         self._coalesce_handle = None
