@@ -10,6 +10,10 @@ OUT=/root/repo/TPU_SESSION_r5
 mkdir -p "$OUT"
 LOG="$OUT/session.log"
 exec >>"$LOG" 2>&1
+# PID marker: bench.py preempts a running session (the driver's bench is
+# the round's official record and must own the chip)
+echo $$ > /tmp/TUNNEL_SESSION_PID
+trap 'rm -f /tmp/TUNNEL_SESSION_PID' EXIT
 echo "=== tunnel session start $(date -u +%FT%TZ) ==="
 
 run() { # name timeout cmd...
