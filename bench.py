@@ -66,29 +66,60 @@ def log(msg):
 
 # --------------------------------------------------------------------- parent
 
+SESSION_PID_FILE = "/tmp/TUNNEL_SESSION_PID"
+
+
 def _preempt_tunnel_session():
     """If the unattended measurement session (scripts/tunnel_session.sh)
     is mid-run, stop it: this bench is the round's official record and
-    the chip is single-client — contention would wedge the tunnel."""
+    the chip is single-client — contention would wedge the tunnel.
+
+    Never fires for runs that cannot touch the chip (CPU platform /
+    simulated wedge / explicit opt-out), verifies the recorded pgid
+    really is the session before signalling (PID reuse), and keeps the
+    marker when the session could not be stopped."""
+    if (os.environ.get("GUBER_BENCH_NO_PREEMPT")
+            or os.environ.get("GUBER_BENCH_SIMULATE_WEDGE")
+            or os.environ.get("GUBER_BENCH_PLATFORM") == "cpu"):
+        return
     try:
-        with open("/tmp/TUNNEL_SESSION_PID") as f:
-            pid = int(f.read().strip())
+        with open(SESSION_PID_FILE) as f:
+            parts = f.read().split()
+        pid, pgid = int(parts[0]), int(parts[-1])
     except Exception:  # noqa: BLE001 — no session running
         return
     try:
-        if os.getpgrp() == pid:
+        if os.getpgrp() == pgid:
             return  # we ARE the session's own bench step — don't suicide
     except OSError:
         pass
-    log(f"# preempting the unattended tunnel session (pgid {pid})")
+    try:  # PID-reuse guard: is this still the session process?
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmd = f.read().replace(b"\0", b" ")
+        if b"tunnel_session.sh" not in cmd:
+            os.unlink(SESSION_PID_FILE)  # stale marker, owner long gone
+            return
+    except FileNotFoundError:
+        try:
+            os.unlink(SESSION_PID_FILE)
+        except OSError:
+            pass
+        return
+    except OSError:
+        return
+    log(f"# preempting the unattended tunnel session (pgid {pgid})")
     for sig in (15, 9):
         try:
-            os.killpg(pid, sig)
-        except (ProcessLookupError, PermissionError):
+            os.killpg(pgid, sig)
+        except ProcessLookupError:
             break
+        except PermissionError:
+            log("# cannot signal the session (permission); proceeding "
+                "WITHOUT preemption — expect tunnel contention")
+            return  # keep the marker: a later privileged run may succeed
         time.sleep(3.0)
     try:
-        os.unlink("/tmp/TUNNEL_SESSION_PID")
+        os.unlink(SESSION_PID_FILE)
     except OSError:
         pass
     time.sleep(5.0)  # let the killed client's tunnel connection close

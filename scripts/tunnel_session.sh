@@ -10,9 +10,11 @@ OUT=/root/repo/TPU_SESSION_r5
 mkdir -p "$OUT"
 LOG="$OUT/session.log"
 exec >>"$LOG" 2>&1
-# PID marker: bench.py preempts a running session (the driver's bench is
-# the round's official record and must own the chip)
-echo $$ > /tmp/TUNNEL_SESSION_PID
+# Marker "<pid> <pgid>": bench.py verifies <pid> still runs this script
+# (PID-reuse guard) and preempts via killpg(<pgid>) — correct whether or
+# not the launcher used setsid.  The driver's bench is the round's
+# official record and must own the chip.
+echo "$$ $(ps -o pgid= -p $$ | tr -d ' ')" > /tmp/TUNNEL_SESSION_PID
 trap 'rm -f /tmp/TUNNEL_SESSION_PID' EXIT
 echo "=== tunnel session start $(date -u +%FT%TZ) ==="
 

@@ -7,8 +7,7 @@ cd /root/repo
 while true; do
   # never probe while a bench runs (driver's official run or the
   # session's): two tunnel clients contending can wedge the chip
-  if pgrep -f "python bench.py" >/dev/null || \
-     pgrep -f "GUBER_BENCH_CHILD" >/dev/null; then
+  if pgrep -f 'bench\.py' >/dev/null; then
     echo "bench running; probe skipped at $(date -u)"
     sleep 240
     continue

@@ -1,0 +1,41 @@
+"""bench.py must never record 0.0 (round-4 regression: BENCH_r04.json
+recorded a bare zero when the tunnel was wedged at driver time).
+
+Runs the real bench entrypoint with the simulated-wedge hook and a small
+wall budget: even when the parent kills the child mid-tier, the printed
+record must carry the stale real-TPU headline from the durable
+checkpoint, the cpu-fallback tagging, and a parseable single-line JSON
+shape.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_wedged_tunnel_yields_stale_headline_not_zero():
+    env = dict(os.environ,
+               GUBER_BENCH_SIMULATE_WEDGE="1",
+               GUBER_BENCH_BUDGET_S="45")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, timeout=240, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.decode().splitlines()
+             if ln.strip().startswith("{")]
+    assert len(lines) == 1, proc.stdout[-2000:]
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "rate_limit_decisions_per_sec_per_chip"
+    assert rec["value"] > 0, rec
+    assert rec["vs_baseline"] > 0, rec
+    assert rec["backend"] == "cpu-fallback", rec
+    assert "tunnel_error" in rec, rec
+    # the stale headline comes from the durable real-TPU checkpoint
+    assert rec.get("stale") is True, rec
+    assert rec.get("stale_measured_at"), rec
