@@ -7,7 +7,10 @@ cd /root/repo
 while true; do
   # never probe while a bench runs (driver's official run or the
   # session's): two tunnel clients contending can wedge the chip
-  if pgrep -f 'bench\.py' >/dev/null; then
+  # anchored: a python interpreter RUNNING bench.py as its script — not
+  # any process whose argv merely mentions the name (the driver's own
+  # harness quotes "bench.py" in its prompt text)
+  if pgrep -f '^[^ ]*python[^ ]* [^ ]*bench\.py' >/dev/null; then
     echo "bench running; probe skipped at $(date -u)"
     sleep 240
     continue
