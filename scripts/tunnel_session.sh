@@ -56,4 +56,20 @@ run xla_cert_onchip 1200 python scripts/onchip_pallas_suite.py
 #    BENCH_TPU_CHECKPOINT.json as they complete)
 run bench 1300 python bench.py
 
+# Digest: one readable file the judge/next round can consume even if no
+# human processes the raw .out files (the driver commits uncommitted
+# work at round end, so a post-builder heal still lands in the repo).
+{
+  echo "# TPU session digest ($(date -u +%FT%TZ))"
+  echo
+  for f in bisect xla_int64 xla_compact32 pallas_mosaic stack_depth \
+           pallas_cert_onchip xla_cert_onchip bench; do
+    if [ -f "$OUT/$f.out" ]; then
+      echo "## $f"
+      grep -E "ms/window|ms/dispatch|per-window|parity|CERTIFIED|MISMATCH|decisions|tier|stale|error|FAILED|rc=" \
+        "$OUT/$f.out" | tail -25
+      echo
+    fi
+  done
+} > "$OUT/SUMMARY.md"
 echo "=== tunnel session end $(date -u +%FT%TZ) ==="
