@@ -32,11 +32,24 @@ the kernels in interpret mode and pin them against the XLA implementation).
 from __future__ import annotations
 
 import functools
+import sys
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+
+# Lowering the kernel's fused window-math jaxpr (closed-form ladder +
+# replay loop as ONE Mosaic kernel) recurses past CPython's default 1000
+# frames inside jax's mlir lowering on real TPU (observed: RecursionError
+# during the OUTER jit's compile, at first call of the compiled step —
+# interpret mode on CPU stays shallower and never trips it).  The bump
+# must be process-global: the lowering runs at unpredictable first-call
+# sites, not under any lexical scope here.  The jaxpr nesting is finite
+# (a few thousand frames), and CPython 3.12 heap-allocates Python-to-
+# Python frames, so the higher ceiling does not threaten the C stack.
+if sys.getrecursionlimit() < 20000:
+    sys.setrecursionlimit(20000)
 
 from gubernator_tpu.ops import kernel
 from gubernator_tpu.ops.kernel import (
