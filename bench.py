@@ -931,9 +931,11 @@ def child_main():
         tier["host_sync_decisions_per_sec"] = round(sync_ps, 1)
         checkpoint()
 
+        from gubernator_tpu.config import env_int
         e2e_ps, ping_p50, herd_rps, herd_p99 = bench_e2e(
             mesh, capacity, lanes, seconds=3.0 if on_cpu else 5.0,
-            concurrency=8 if on_cpu else 32)
+            concurrency=env_int("GUBER_BENCH_E2E_CONC",
+                                8 if on_cpu else 32))
         tier["e2e_decisions_per_sec"] = round(e2e_ps, 1)
         tier["healthcheck_rtt_ms_p50"] = round(ping_p50, 3)
         tier["thundering_herd_rps"] = round(herd_rps, 1)

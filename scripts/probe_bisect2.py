@@ -1,0 +1,79 @@
+"""Bisect continuation: ONLY the two stages the full ladder timed out on.
+
+probe_bisect_window.py walked decode (0.32ms) -> +prep (1.83ms) ->
++closed (0.64ms) before its stage compiles exhausted the session budget;
+the full window_step / pipeline-body stages — where the other ~15ms of
+the measured ~17.6ms/window must live — never ran.  This probe runs just
+those two, with a cheaper K-slope pair (2 vs 6) to keep the unrolled
+compiles small.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from scripts._probe_env import setup as _setup
+_setup()
+
+from gubernator_tpu.ops import kernel
+from gubernator_tpu.ops.kernel import BucketState
+
+B = int(os.environ.get("GUBER_PROBE_B", "32768"))
+C = int(os.environ.get("GUBER_PROBE_C", str(1 << 20)))
+now0 = 1_700_000_000_000
+rng = np.random.default_rng(5)
+print(f"# backend: {jax.devices()[0].platform}", file=sys.stderr, flush=True)
+
+slots = ((rng.zipf(1.1, B) - 1) % C).astype(np.int64)
+packed = np.zeros((B, 2), np.int64)
+packed[:, 0] = (slots + 1) | (1 << 34)
+packed[:, 1] = np.int64(1_000_000) | (np.int64(600_000) << 32)
+dpacked = jax.device_put(packed)
+
+
+def v_full_step(state, pk, now):
+    bt = kernel.decode_batch(pk)
+    state, out = kernel.window_step(state, bt, now)
+    return state, jnp.sum(out.remaining)
+
+
+def v_pipeline(state, pk, now):
+    bt = kernel.decode_batch(pk)
+    state, out = kernel.window_step(state, bt, now)
+    word = kernel.encode_output_word(out, now)
+    mism = jnp.any((out.limit != bt.limit) & (bt.slot >= 0))
+    return state, jnp.sum(word) + mism.astype(jnp.int64)
+
+
+def slope(v, klo=2, khi=6):
+    fns = {}
+    for k in (klo, khi):
+        def go(state, pk, _k=k):
+            acc = jnp.int64(0)
+            for i in range(_k):
+                state, s = v(state, pk, now0 + i + acc % 3)
+                acc = acc + s
+            return acc
+        fns[k] = jax.jit(go, donate_argnums=(0,))
+
+    def t(k, reps=5):
+        np.asarray(fns[k](BucketState.zeros(C), dpacked))
+        ts = []
+        for _ in range(reps):
+            st = BucketState.zeros(C)
+            jax.block_until_ready(st.limit)
+            t0 = time.perf_counter()
+            np.asarray(fns[k](st, dpacked))
+            ts.append(time.perf_counter() - t0)
+        return float(np.percentile(np.array(ts) * 1e3, 50))
+    return (t(khi) - t(klo)) / (khi - klo)
+
+
+for name, v in [("full window_step", v_full_step),
+                ("pipeline body", v_pipeline)]:
+    print(f"{name:20s} {slope(v):8.2f}ms/window", flush=True)

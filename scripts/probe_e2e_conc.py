@@ -1,0 +1,36 @@
+"""Sweep the e2e tier's in-flight stream count on the real chip.
+
+The e2e headline runs 32 concurrent 1000-item RPC streams — exactly one
+32k-lane drain window in flight.  With the ~70ms tunnel fetch RTT, the
+pipelined ceiling is (decisions in flight) / RTT, so stream count is a
+first-order lever the round-4 runs never probed.  Prints decisions/s per
+concurrency; the winner becomes the TPU default in bench.py.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts._probe_env import setup as _setup
+_setup()
+
+import jax
+
+import bench as b
+
+devs = jax.devices()
+print(f"# backend: {devs[0].platform}", flush=True)
+mesh = b.make_serving_mesh() if hasattr(b, "make_serving_mesh") else None
+if mesh is None:
+    from gubernator_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(devs[:1])
+
+CAP = int(os.environ.get("GUBER_PROBE_C", str(1 << 20)))
+LANES = int(os.environ.get("GUBER_PROBE_B", "32768"))
+
+for conc in (32, 64, 128, 256):
+    e2e_ps, ping_p50, herd_rps, herd_p99 = b.bench_e2e(
+        mesh, CAP, LANES, seconds=4.0, concurrency=conc)
+    print(f"conc={conc:4d}: e2e {e2e_ps:,.0f} decisions/s  "
+          f"ping p50 {ping_p50:.2f}ms  herd {herd_rps:,.0f}rps "
+          f"p99 {herd_p99:.1f}ms", flush=True)

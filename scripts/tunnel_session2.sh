@@ -1,0 +1,48 @@
+#!/bin/bash
+# Round-5 SECOND on-chip session — run after the first session's partial
+# results (TPU_SESSION_r5/) and the pallas recursion fix (commit bfbf614).
+# Priorities re-ranked by what the first session answered:
+#   1. Pallas A/B — the one-op window-math kernel is the only lever left
+#      (cost is per-executed-op; XLA path saturates ~1.86M/s/chip).
+#   2. Pallas on-chip certification (correctness on real Mosaic).
+#   3. Bisect continuation (the two stages the first ladder timed out on).
+#   4. Full bench (tier checkpoints persist as they complete).
+set -u
+cd /root/repo
+OUT=/root/repo/TPU_SESSION_r5b
+mkdir -p "$OUT"
+LOG="$OUT/session.log"
+exec >>"$LOG" 2>&1
+echo "$$ $(ps -o pgid= -p $$ | tr -d ' ')" > /tmp/TUNNEL_SESSION_PID
+trap 'rm -f /tmp/TUNNEL_SESSION_PID' EXIT
+echo "=== tunnel session2 start $(date -u +%FT%TZ) ==="
+
+run() { # name timeout cmd...
+  local name=$1 to=$2; shift 2
+  echo "--- $name ($(date -u +%T)) ---"
+  timeout "$to" "$@" > "$OUT/$name.out" 2>&1
+  local rc=$?
+  echo "$name rc=$rc"
+  tail -20 "$OUT/$name.out"
+  return $rc
+}
+
+run pallas_ab 1200 env GUBER_PALLAS=1 python scripts/probe_pallas_ab.py
+run pallas_cert 1200 env GUBER_PALLAS=1 python scripts/onchip_pallas_suite.py
+run bisect2 1200 python scripts/probe_bisect2.py
+run e2e_conc 1200 python scripts/probe_e2e_conc.py
+run bench 1300 python bench.py
+
+{
+  echo "# TPU session2 digest ($(date -u +%FT%TZ))"
+  echo
+  for f in pallas_ab pallas_cert bisect2 e2e_conc bench; do
+    if [ -f "$OUT/$f.out" ]; then
+      echo "## $f"
+      grep -E "ms/window|ms/dispatch|per-window|parity|CERTIFIED|MISMATCH|decisions|tier|stale|error|FAILED|rc=" \
+        "$OUT/$f.out" | tail -25
+      echo
+    fi
+  done
+} > "$OUT/SUMMARY.md"
+echo "=== tunnel session2 end $(date -u +%FT%TZ) ==="

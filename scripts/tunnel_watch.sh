@@ -15,6 +15,13 @@ while true; do
     sleep 240
     continue
   fi
+  # ...nor while a measurement session owns the chip (a concurrent probe
+  # is a second tunnel client — the known contention wedge)
+  if pgrep -f "^bash /root/repo/scripts/tunnel_session2?\.sh" >/dev/null; then
+    echo "session running; probe skipped at $(date -u)"
+    sleep 240
+    continue
+  fi
   timeout 75 python -c "
 import jax
 d = jax.devices()
@@ -29,7 +36,7 @@ print('ALIVE', d[0].platform, x, flush=True)
     # first — the tunnel historically re-wedges within ~2h)
     if [ ! -f /tmp/TUNNEL_SESSION_STARTED ]; then
       touch /tmp/TUNNEL_SESSION_STARTED
-      setsid nohup bash /root/repo/scripts/tunnel_session.sh \
+      setsid nohup bash /root/repo/scripts/tunnel_session2.sh \
         > /tmp/tunnel_session_launch.log 2>&1 &
       echo "tunnel session launched"
     fi
@@ -39,7 +46,7 @@ print('ALIVE', d[0].platform, x, flush=True)
     # session is still running (a transient probe failure mid-session
     # must not queue a second overlapping session)
     if [ -f /tmp/TUNNEL_SESSION_STARTED ] && \
-       ! pgrep -f tunnel_session.sh >/dev/null; then
+       ! pgrep -f "^bash /root/repo/scripts/tunnel_session2?\.sh" >/dev/null; then
       rm -f /tmp/TUNNEL_SESSION_STARTED
       echo "session trigger re-armed"
     fi

@@ -254,3 +254,22 @@ def test_engine_compact_serving_uses_compact32(monkeypatch):
         b = plain.process(reqs, now=T0 + i)
         assert [(int(x.status), x.remaining, x.reset_time) for x in a] == \
             [(int(y.status), y.remaining, y.reset_time) for y in b], i
+
+
+def test_import_raises_recursion_ceiling():
+    """Real-Mosaic lowering of the fused window-math jaxpr needs more than
+    CPython's default 1000 frames (observed on-chip: RecursionError inside
+    jax's MLIR lowering at the outer jit's first call).  The bump must ride
+    the module IMPORT — checked in a fresh interpreter so the assertion
+    exercises the import path rather than this process's mutable global."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+         "import gubernator_tpu.ops.pallas_kernel\n"
+         "import sys; print(sys.getrecursionlimit())"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert int(out.stdout.strip()) >= 20000
