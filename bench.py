@@ -44,6 +44,7 @@ Optional: GUBER_PROFILE=<dir> wraps the host tier in a jax.profiler trace.
 
 import json
 import os
+import re
 import subprocess
 import sys
 import tempfile
@@ -842,6 +843,61 @@ def child_main():
             except OSError:
                 pass
 
+    def pick_pallas(result, deadline):
+        """On-chip Pallas-vs-XLA A/B in SUBPROCESSES (same pre-init slot
+        as the stack-depth probe; executables cache per (mesh, pallas),
+        so each mode needs a fresh process) -> serve the tiers under
+        GUBER_PALLAS=1 iff the Pallas window ran ON TPU, is word-exact,
+        AND is >=10% faster.  An explicit GUBER_PALLAS in the env wins
+        either way; any probe failure keeps the proven XLA path.
+        `deadline` (perf_counter) is shared with pick_stack_depth so the
+        pre-init probes can never starve the tiers."""
+        if os.environ.get("GUBER_PALLAS") is not None:
+            return
+        probe = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "scripts", "probe_pallas_ab.py")
+        quick = {**os.environ, "GUBER_PROBE_KHI": "5",
+                 "GUBER_PROBE_REPS": "4"}
+
+        def run_mode(pallas):
+            budget = deadline - time.perf_counter()
+            if budget < 30:
+                raise RuntimeError("pre-init probe deadline exhausted")
+            env = dict(quick)
+            if pallas:
+                env["GUBER_PALLAS"] = "1"
+            proc = subprocess.run([sys.executable, probe],
+                                  timeout=min(300.0, budget),
+                                  capture_output=True, env=env)
+            text = (proc.stdout or b"").decode(errors="replace")
+            errs = (proc.stderr or b"").decode(errors="replace")
+            # K-slope of few quick reps can come out epsilon-negative for
+            # a near-free window: a valid "essentially 0ms" measurement
+            m = re.search(r"per-window\s+(-?[0-9.]+)ms", text)
+            if proc.returncode != 0 or not m:
+                raise RuntimeError(f"rc={proc.returncode} {errs[-200:]}")
+            if "# backend: tpu" not in errs:
+                # probe fell back to CPU: interpret-Pallas-vs-XLA smoke
+                # timings must not drive (or be recorded as) a TPU choice
+                raise RuntimeError("probe ran on cpu, not applied")
+            return max(float(m.group(1)), 0.01), "EXACT" in text
+
+        try:
+            xla_ms, _ = run_mode(pallas=False)
+            pal_ms, pal_exact = run_mode(pallas=True)
+            result["pallas_ab_ms"] = {"xla": round(xla_ms, 2),
+                                      "pallas": round(pal_ms, 2)}
+            if pal_exact and pal_ms < xla_ms * 0.9:
+                os.environ["GUBER_PALLAS"] = "1"
+                result["serving_pallas"] = True
+                log(f"# pallas A/B: {pal_ms:.2f}ms vs xla {xla_ms:.2f}ms "
+                    f"per window, parity EXACT — serving tiers use Pallas")
+            else:
+                log(f"# pallas A/B: pallas {pal_ms:.2f}ms (exact={pal_exact}) "
+                    f"vs xla {xla_ms:.2f}ms — keeping XLA")
+        except Exception as e:  # noqa: BLE001 — optional optimization
+            log(f"# pallas A/B skipped: {type(e).__name__}: {str(e)[:200]}")
+
     tunnel_error = None
     try:
         try:
@@ -853,7 +909,12 @@ def child_main():
                 # (the kill-nudge attempts double as wedge recovery if
                 # the probe left the tunnel in a bad state)
                 acquire_backend(init=False)
+                # shared pre-init probe deadline: stack-depth + the two
+                # pallas A/B subprocesses together may not eat the tiers'
+                # wall budget (pick_stack_depth keeps its own 240s cap)
+                probe_deadline = time.perf_counter() + 420.0
                 pick_stack_depth(result)
+                pick_pallas(result, probe_deadline)
             devs = acquire_backend()
         except RuntimeError as e:
             # tunnel wedged: fall back to CPU smoke tiers so the round
@@ -865,6 +926,11 @@ def child_main():
             tunnel_error = str(e)
             log(f"# TPU unavailable ({tunnel_error}); falling back to "
                 f"CPU smoke tiers")
+            # a pallas adoption decided by the on-chip A/B must not leak
+            # into the CPU smoke tiers (interpret mode: Python-level
+            # kernel emulation, garbage numbers)
+            if result.pop("serving_pallas", None):
+                os.environ.pop("GUBER_PALLAS", None)
             result["backend"] = "cpu-fallback"
             result["tunnel_error"] = tunnel_error
             stale = _load_tpu_checkpoint()

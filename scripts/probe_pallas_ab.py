@@ -30,6 +30,8 @@ from gubernator_tpu.parallel.mesh import make_mesh
 
 B = int(os.environ.get("GUBER_PROBE_B", "32768"))
 CAP = int(os.environ.get("GUBER_PROBE_C", str(1 << 20)))
+KHI = int(os.environ.get("GUBER_PROBE_KHI", "9"))
+REPS = int(os.environ.get("GUBER_PROBE_REPS", "8"))
 now0 = 1_700_000_000_000
 devs = jax.devices()
 if os.environ.get("GUBER_PALLAS") == "1":
@@ -57,7 +59,7 @@ def stacked_time(k):
 
     words = None
     ts = []
-    for rep in range(8):
+    for rep in range(REPS):
         t0 = time.perf_counter()
         words, _, _ = eng.pipeline_dispatch(dpacked, nows + rep * k,
                                             n_windows=k)
@@ -68,9 +70,9 @@ def stacked_time(k):
 
 
 t1, w1, packed1 = stacked_time(1)
-t9, _, _ = stacked_time(9)
-per = (t9 - t1) / 8
-print(f"{mode}: K=1 {t1:.2f}ms  K=9 {t9:.2f}ms  -> per-window {per:.2f}ms",
+t9, _, _ = stacked_time(KHI)
+per = (t9 - t1) / (KHI - 1)
+print(f"{mode}: K=1 {t1:.2f}ms  K={KHI} {t9:.2f}ms  -> per-window {per:.2f}ms",
       flush=True)
 
 # Functional parity: replay the K=1 run's EXACT 8 windows through the
@@ -83,12 +85,12 @@ from gubernator_tpu.ops import kernel  # noqa: E402
 
 st = kernel.BucketState.zeros(CAP)
 bt = kernel.decode_batch(jnp.asarray(packed1[0, 0]))
-for rep in range(8):
+for rep in range(REPS):
     st, out = kernel.window_step(st, bt, jnp.int64(now0 + rep))
-ref = np.asarray(kernel.encode_output_word(out, jnp.int64(now0 + 7)))
+ref = np.asarray(kernel.encode_output_word(out, jnp.int64(now0 + REPS - 1)))
 assert w1.shape[-1] == ref.shape[-1], (w1.shape, ref.shape)
 match = np.array_equal(w1[0, 0], ref)
-print(f"parity vs host XLA kernel over 8 replayed windows: "
+print(f"parity vs host XLA kernel over {REPS} replayed windows: "
       f"{'EXACT' if match else 'MISMATCH'} "
       f"({int((w1[0, 0] != ref).sum())} differing words of {B})",
       flush=True)
