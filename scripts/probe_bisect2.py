@@ -36,6 +36,21 @@ packed[:, 1] = np.int64(1_000_000) | (np.int64(600_000) << 32)
 dpacked = jax.device_put(packed)
 
 
+def v_closed_commit(state, pk, now):
+    """Closed form + arena commit, replay while_loop SKIPPED: brackets the
+    loop's fixed cost (full - this) and the commit scatters' cost
+    (this - the first ladder's decode+prep+closed)."""
+    from gubernator_tpu.ops.kernel import _Reg
+    bt = kernel.decode_batch(pk)
+    prep = kernel.window_prep(state, bt, now)
+    st = _Reg(*jax.tree.map(lambda a: a[prep.seg_start_idx], prep.cur))
+    ff_reg, ff_out = kernel.uniform_closed_form(
+        st, prep.fresh_seg | (prep.a0 != st.algo), prep.h0, prep.l0,
+        prep.d0, prep.a0, prep.pos, prep.seg_len, now)
+    state, out = kernel.window_commit(state, prep, ff_reg, ff_out)
+    return state, jnp.sum(out.remaining)
+
+
 def v_full_step(state, pk, now):
     bt = kernel.decode_batch(pk)
     state, out = kernel.window_step(state, bt, now)
@@ -74,6 +89,7 @@ def slope(v, klo=2, khi=6):
     return (t(khi) - t(klo)) / (khi - klo)
 
 
-for name, v in [("full window_step", v_full_step),
+for name, v in [("closed+commit", v_closed_commit),
+                ("full window_step", v_full_step),
                 ("pipeline body", v_pipeline)]:
     print(f"{name:20s} {slope(v):8.2f}ms/window", flush=True)
