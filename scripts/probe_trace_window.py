@@ -1,0 +1,85 @@
+"""Device-trace one serving drain window and name every kernel's cost.
+
+The round-5 K-slope data says a 32k-lane window costs ~17.6ms of real
+per-iteration device execution, but stage bisects bracket the cheap
+stages at ~2ms — where the rest goes is op-level information only a
+profiler trace can give.  jax.profiler.trace writes an XSpace proto;
+tensorflow (baked into this image) carries the parser, so this probe
+aggregates device-plane event durations by op name and prints the top
+spenders.  If the axon runtime does not support device tracing, the
+probe says so and exits 0 (host-plane-only traces still print).
+"""
+import glob
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+from scripts._probe_env import setup as _setup
+_setup()
+
+from gubernator_tpu.core.engine import RateLimitEngine
+from gubernator_tpu.parallel.mesh import make_mesh
+
+B = int(os.environ.get("GUBER_PROBE_B", "32768"))
+CAP = int(os.environ.get("GUBER_PROBE_C", str(1 << 20)))
+now0 = 1_700_000_000_000
+OUT = os.environ.get("GUBER_TRACE_DIR", "/tmp/guber_trace")
+
+devs = jax.devices()
+print(f"# backend: {devs[0].platform}", file=sys.stderr, flush=True)
+mesh = make_mesh(devs[:1])
+rng = np.random.default_rng(5)
+
+eng = RateLimitEngine(mesh=mesh, capacity_per_shard=CAP, batch_per_shard=B,
+                      global_capacity=64, global_batch_per_shard=8,
+                      max_global_updates=8)
+slots = ((rng.zipf(1.1, (4, B)) - 1) % CAP).astype(np.int64)
+packed = np.zeros((4, 1, B, 2), np.int64)
+packed[:, 0, :, 0] = (slots + 1) | (1 << 34)
+packed[:, 0, :, 1] = np.int64(1_000_000) | (np.int64(600_000) << 32)
+dpacked = jax.device_put(packed)
+nows = now0 + np.arange(4, dtype=np.int64)
+
+# warm (compile outside the trace)
+w, _, _ = eng.pipeline_dispatch(dpacked, nows, n_windows=4)
+np.asarray(w)
+
+with jax.profiler.trace(OUT):
+    for rep in range(3):
+        w, _, _ = eng.pipeline_dispatch(dpacked, nows + 4 * (rep + 1),
+                                        n_windows=4)
+        np.asarray(w)
+
+paths = sorted(glob.glob(OUT + "/**/*.xplane.pb", recursive=True),
+               key=os.path.getmtime)
+if not paths:
+    print("no xplane written — runtime does not support jax.profiler here")
+    sys.exit(0)
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: E402
+
+space = xplane_pb2.XSpace()
+with open(paths[-1], "rb") as f:
+    space.ParseFromString(f.read())
+
+for plane in space.planes:
+    total_by_name = {}
+    for line in plane.lines:
+        for ev in line.events:
+            md = plane.event_metadata.get(ev.metadata_id)
+            name = md.name if md else str(ev.metadata_id)
+            total_by_name[name] = (total_by_name.get(name, 0)
+                                   + ev.duration_ps)
+    if not total_by_name:
+        continue
+    tot_ms = sum(total_by_name.values()) / 1e9
+    print(f"\n== plane: {plane.name}  (sum {tot_ms:.2f}ms over 12 windows)",
+          flush=True)
+    for name, ps in sorted(total_by_name.items(), key=lambda kv: -kv[1])[:30]:
+        print(f"  {ps / 1e9:9.3f}ms  {name[:110]}")

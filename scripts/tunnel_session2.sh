@@ -31,12 +31,13 @@ run pallas_ab 1200 env GUBER_PALLAS=1 python scripts/probe_pallas_ab.py
 run pallas_cert 1200 env GUBER_PALLAS=1 python scripts/onchip_pallas_suite.py
 run bisect2 1200 python scripts/probe_bisect2.py
 run e2e_conc 1200 python scripts/probe_e2e_conc.py
+run trace 900 python scripts/probe_trace_window.py
 run bench 1300 python bench.py
 
 {
   echo "# TPU session2 digest ($(date -u +%FT%TZ))"
   echo
-  for f in pallas_ab pallas_cert bisect2 e2e_conc bench; do
+  for f in pallas_ab pallas_cert bisect2 e2e_conc trace bench; do
     if [ -f "$OUT/$f.out" ]; then
       echo "## $f"
       grep -E "ms/window|ms/dispatch|per-window|parity|CERTIFIED|MISMATCH|decisions|tier|stale|error|FAILED|rc=" \
