@@ -43,7 +43,10 @@ JSON_OUT = next((a.split("=", 1)[1] for a in sys.argv
 C = 1 << 14 if ON_CPU else 1 << 20
 if QUICK:
     # bench-integrated mode: just enough points to pick the serving K
-    KS = (1, 4) if ON_CPU else (8, 32, 128)
+    # throughput is ~flat in K on-chip (round-5 surface: 1.80M/s at K=1
+    # -> 1.87M/s at K=128), so the quick pick only needs the knee; small
+    # Ks also keep the bucket-ladder compiles cheap over the tunnel
+    KS = (1, 4) if ON_CPU else (4, 16)
     BS = (1024,) if ON_CPU else (32768,)
     R1, R2 = (2, 4) if ON_CPU else (2, 6)
 else:
@@ -116,7 +119,14 @@ if JSON_OUT:
     ok = [r for r in results if "decisions_per_sec" in r
           and np.isfinite(r["decisions_per_sec"])
           and r["decisions_per_sec"] > 0]
-    best = max(ok, key=lambda r: r["decisions_per_sec"]) if ok else None
+    # smallest K within 5% of the best rate: measured throughput is ~flat
+    # in K (round-5 on-chip surface), so a marginal win at a big K buys
+    # nothing while its dispatch blocks seconds of tail latency
+    best = None
+    if ok:
+        top = max(r["decisions_per_sec"] for r in ok)
+        near = [r for r in ok if r["decisions_per_sec"] >= 0.95 * top]
+        best = min(near, key=lambda r: (r["K"], r["B"]))
     with open(JSON_OUT + ".tmp", "w") as f:
         f.write(json.dumps({"backend": dev.platform, "points": results,
                             "best": best}))
