@@ -541,7 +541,14 @@ def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
     seg_ok = jnp.ones_like(s_algo).at[seg_start_idx].min(
         lane_ok.astype(I32), mode="drop")
     seg_uniform = (seg_ok[seg_start_idx] == 1) & (h0 > 0)
-    max_pos = jnp.max(jnp.where(s_valid & ~seg_uniform, pos, jnp.int32(-1)))
+    # A singleton aggregated segment (one folded lane owning its slot in
+    # this window — the fold's normal shape) is closed-form too: the agg
+    # transition is a whole-run formula and no replay round could touch
+    # the segment again.  window_step hoists it out of the loop, so it
+    # must not force replay trips here.
+    agg_single = s_agg & (seg_len == 1)
+    max_pos = jnp.max(jnp.where(s_valid & ~seg_uniform & ~agg_single, pos,
+                                jnp.int32(-1)))
 
     return WindowPrep(order, s_slot, s_valid, s_hits, s_limit, s_duration,
                       s_algo, s_init, seg_start, seg_start_idx, pos,
@@ -617,10 +624,21 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
                     algo=rows[:, 5].astype(I32)), rows[:, 6] != 0
 
     cur_packed = pack_reg(cur, cur_fresh)
-    st, _ = unpack_reg(cur_packed[seg_start_idx])
+    st, st_fresh = unpack_reg(cur_packed[seg_start_idx])
     fresh0 = fresh_seg | (a0 != st.algo)
     ff_reg, ff_out = uniform_closed_form(
         st, fresh0, h0, l0, d0, a0, pos, seg_len, now)
+
+    # Singleton aggregated segments (one folded lane owning its slot this
+    # window — the fold's normal shape): the agg transition is a whole-run
+    # closed form, so hoist EXACTLY what the lane's one replay round would
+    # compute (same call, same inputs) to straight line.  It fuses with
+    # the ladder above, and a fold-only window runs ZERO replay trips
+    # (window_prep's max_pos already excludes these lanes).
+    agg_single = s_agg & (seg_len == 1)
+    a_reg, a_out = transition(st, s_hits, s_limit, s_duration, s_algo,
+                              now, st_fresh | (s_algo != st.algo),
+                              agg=s_agg)
 
     # replay buffers start from the fast-path answers; replay rounds only
     # overwrite lanes of non-uniform segments
@@ -628,7 +646,7 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
 
     def round_body(carry):
         p, cur_packed, outs = carry
-        active = (pos == p) & s_valid & ~seg_uniform
+        active = (pos == p) & s_valid & ~seg_uniform & ~agg_single
         reg, reg_fresh = unpack_reg(cur_packed[seg_start_idx])
         # fresh: segment-level miss (expired/new/init at window start — an
         # is_init lane always starts its own virtual segment, so its flag
@@ -655,11 +673,16 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
     )
     cur, _ = unpack_reg(cur_packed)
 
+    outs = WindowOutput(*jax.tree.map(
+        lambda a, o: jnp.where(agg_single, a, o), a_out, outs))
+
     # Uniform segments commit their closed-form state; replayed segments
     # commit the live register (one write per touched slot — the window's
     # net effect, like the mutex-serialized mutations).
     fin = _Reg(*jax.tree.map(
         lambda f, c: jnp.where(seg_uniform, f, c), ff_reg, cur))
+    fin = _Reg(*jax.tree.map(
+        lambda a, f: jnp.where(agg_single, a, f), a_reg, fin))
     return window_commit(state, prep, fin, outs)
 
 

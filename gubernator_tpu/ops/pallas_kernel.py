@@ -160,6 +160,17 @@ def _window_math(now, max_pos, s_valid, s_hits, s_limit, s_duration,
         reg, fresh0 | (a0 != reg.algo), h0, l0, d0, a0,
         p_arr, seg_len, now)
 
+    # ---- singleton aggregated segments: whole-run closed form ----
+    # A folded lane that owns its slot in this window (seg_len == 1, the
+    # fold's normal shape) gets EXACTLY what its one replay round would
+    # compute — same transition call, same inputs — hoisted to straight
+    # line (it fuses with the ladder above; a fold-only window then runs
+    # ZERO replay trips, prep's max_pos already excludes these lanes).
+    agg_single = s_agg & (seg_len == 1)
+    a_reg, a_out = kernel.transition(
+        reg, s_hits, s_limit, s_duration, s_algo, now,
+        fresh0 | (s_algo != reg.algo), agg=s_agg)
+
     # ---- replay rounds for irregular segments ----
     def body(carry):
         p, lim, dur, rem, ts, exp, alg, fr, ost, oli, ore, ors = carry
@@ -172,7 +183,7 @@ def _window_math(now, max_pos, s_valid, s_hits, s_limit, s_duration,
         new_r, resp = kernel.transition(
             r, s_hits, s_limit, s_duration, s_algo, now, fresh,
             agg=s_agg)
-        active = (p_arr == p) & valid & ~uniform
+        active = (p_arr == p) & valid & ~uniform & ~agg_single
         # Propagate the active lane's result to its WHOLE segment (the
         # final commit reads registers at segment-start lanes, pos 0).
         # ai = my segment start + p; active[ai] holds iff pos[ai] == p,
@@ -206,8 +217,11 @@ def _window_math(now, max_pos, s_valid, s_hits, s_limit, s_duration,
     carry = lax.while_loop(lambda c: c[0] <= max_pos, body, init)
     (_, lim, dur, rem, ts, exp, alg, _, ost, oli, ore, ors) = carry
 
-    out_sorted = WindowOutput(status=ost, limit=oli, remaining=ore,
-                              reset_time=ors)
+    out_sorted = WindowOutput(
+        status=jnp.where(agg_single, a_out.status, ost),
+        limit=jnp.where(agg_single, a_out.limit, oli),
+        remaining=jnp.where(agg_single, a_out.remaining, ore),
+        reset_time=jnp.where(agg_single, a_out.reset_time, ors))
     fin = _Reg(
         limit=jnp.where(uniform, ff_reg.limit, lim),
         duration=jnp.where(uniform, ff_reg.duration, dur),
@@ -215,6 +229,8 @@ def _window_math(now, max_pos, s_valid, s_hits, s_limit, s_duration,
         tstamp=jnp.where(uniform, ff_reg.tstamp, ts),
         expire=jnp.where(uniform, ff_reg.expire, exp),
         algo=jnp.where(uniform, ff_reg.algo, alg))
+    fin = _Reg(*jax.tree.map(
+        lambda a, f: jnp.where(agg_single, a, f), a_reg, fin))
     return out_sorted, fin
 
 
