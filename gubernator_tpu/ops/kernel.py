@@ -541,13 +541,13 @@ def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
     seg_ok = jnp.ones_like(s_algo).at[seg_start_idx].min(
         lane_ok.astype(I32), mode="drop")
     seg_uniform = (seg_ok[seg_start_idx] == 1) & (h0 > 0)
-    # A singleton aggregated segment (one folded lane owning its slot in
-    # this window — the fold's normal shape) is closed-form too: the agg
-    # transition is a whole-run formula and no replay round could touch
-    # the segment again.  window_step hoists it out of the loop, so it
-    # must not force replay trips here.
-    agg_single = s_agg & (seg_len == 1)
-    max_pos = jnp.max(jnp.where(s_valid & ~seg_uniform & ~agg_single, pos,
+    # A singleton non-uniform segment — a folded (aggregated-run) lane
+    # owning its slot this window, or a lone hits=0 peek — is closed-form
+    # too: its one replay round would read exactly the window-entry
+    # register, so window_step hoists that same transition call out of
+    # the loop and it must not force replay trips here.
+    seg_single = s_valid & ~seg_uniform & (seg_len == 1)
+    max_pos = jnp.max(jnp.where(s_valid & ~seg_uniform & ~seg_single, pos,
                                 jnp.int32(-1)))
 
     return WindowPrep(order, s_slot, s_valid, s_hits, s_limit, s_duration,
@@ -629,13 +629,13 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
     ff_reg, ff_out = uniform_closed_form(
         st, fresh0, h0, l0, d0, a0, pos, seg_len, now)
 
-    # Singleton aggregated segments (one folded lane owning its slot this
-    # window — the fold's normal shape): the agg transition is a whole-run
-    # closed form, so hoist EXACTLY what the lane's one replay round would
-    # compute (same call, same inputs) to straight line.  It fuses with
+    # Singleton non-uniform segments (a folded lane owning its slot this
+    # window — the fold's normal shape — or a lone hits=0 peek): their one
+    # replay round reads exactly the window-entry register, so hoist the
+    # SAME transition call (same inputs) to straight line.  It fuses with
     # the ladder above, and a fold-only window runs ZERO replay trips
     # (window_prep's max_pos already excludes these lanes).
-    agg_single = s_agg & (seg_len == 1)
+    seg_single = s_valid & ~seg_uniform & (seg_len == 1)
     a_reg, a_out = transition(st, s_hits, s_limit, s_duration, s_algo,
                               now, st_fresh | (s_algo != st.algo),
                               agg=s_agg)
@@ -646,7 +646,7 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
 
     def round_body(carry):
         p, cur_packed, outs = carry
-        active = (pos == p) & s_valid & ~seg_uniform & ~agg_single
+        active = (pos == p) & s_valid & ~seg_uniform & ~seg_single
         reg, reg_fresh = unpack_reg(cur_packed[seg_start_idx])
         # fresh: segment-level miss (expired/new/init at window start — an
         # is_init lane always starts its own virtual segment, so its flag
@@ -674,7 +674,7 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
     cur, _ = unpack_reg(cur_packed)
 
     outs = WindowOutput(*jax.tree.map(
-        lambda a, o: jnp.where(agg_single, a, o), a_out, outs))
+        lambda a, o: jnp.where(seg_single, a, o), a_out, outs))
 
     # Uniform segments commit their closed-form state; replayed segments
     # commit the live register (one write per touched slot — the window's
@@ -682,7 +682,7 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
     fin = _Reg(*jax.tree.map(
         lambda f, c: jnp.where(seg_uniform, f, c), ff_reg, cur))
     fin = _Reg(*jax.tree.map(
-        lambda a, f: jnp.where(agg_single, a, f), a_reg, fin))
+        lambda a, f: jnp.where(seg_single, a, f), a_reg, fin))
     return window_commit(state, prep, fin, outs)
 
 

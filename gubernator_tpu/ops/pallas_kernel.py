@@ -160,13 +160,13 @@ def _window_math(now, max_pos, s_valid, s_hits, s_limit, s_duration,
         reg, fresh0 | (a0 != reg.algo), h0, l0, d0, a0,
         p_arr, seg_len, now)
 
-    # ---- singleton aggregated segments: whole-run closed form ----
-    # A folded lane that owns its slot in this window (seg_len == 1, the
-    # fold's normal shape) gets EXACTLY what its one replay round would
-    # compute — same transition call, same inputs — hoisted to straight
-    # line (it fuses with the ladder above; a fold-only window then runs
-    # ZERO replay trips, prep's max_pos already excludes these lanes).
-    agg_single = s_agg & (seg_len == 1)
+    # ---- singleton non-uniform segments: whole-run closed form ----
+    # A folded lane that owns its slot in this window (the fold's normal
+    # shape) or a lone hits=0 peek gets EXACTLY what its one replay round
+    # would compute — same transition call, same inputs — hoisted to
+    # straight line (it fuses with the ladder above; a fold-only window
+    # then runs ZERO replay trips, prep's max_pos excludes these lanes).
+    seg_single = valid & ~uniform & (seg_len == 1)
     a_reg, a_out = kernel.transition(
         reg, s_hits, s_limit, s_duration, s_algo, now,
         fresh0 | (s_algo != reg.algo), agg=s_agg)
@@ -183,7 +183,7 @@ def _window_math(now, max_pos, s_valid, s_hits, s_limit, s_duration,
         new_r, resp = kernel.transition(
             r, s_hits, s_limit, s_duration, s_algo, now, fresh,
             agg=s_agg)
-        active = (p_arr == p) & valid & ~uniform & ~agg_single
+        active = (p_arr == p) & valid & ~uniform & ~seg_single
         # Propagate the active lane's result to its WHOLE segment (the
         # final commit reads registers at segment-start lanes, pos 0).
         # ai = my segment start + p; active[ai] holds iff pos[ai] == p,
@@ -218,10 +218,10 @@ def _window_math(now, max_pos, s_valid, s_hits, s_limit, s_duration,
     (_, lim, dur, rem, ts, exp, alg, _, ost, oli, ore, ors) = carry
 
     out_sorted = WindowOutput(
-        status=jnp.where(agg_single, a_out.status, ost),
-        limit=jnp.where(agg_single, a_out.limit, oli),
-        remaining=jnp.where(agg_single, a_out.remaining, ore),
-        reset_time=jnp.where(agg_single, a_out.reset_time, ors))
+        status=jnp.where(seg_single, a_out.status, ost),
+        limit=jnp.where(seg_single, a_out.limit, oli),
+        remaining=jnp.where(seg_single, a_out.remaining, ore),
+        reset_time=jnp.where(seg_single, a_out.reset_time, ors))
     fin = _Reg(
         limit=jnp.where(uniform, ff_reg.limit, lim),
         duration=jnp.where(uniform, ff_reg.duration, dur),
@@ -230,7 +230,7 @@ def _window_math(now, max_pos, s_valid, s_hits, s_limit, s_duration,
         expire=jnp.where(uniform, ff_reg.expire, exp),
         algo=jnp.where(uniform, ff_reg.algo, alg))
     fin = _Reg(*jax.tree.map(
-        lambda a, f: jnp.where(agg_single, a, f), a_reg, fin))
+        lambda a, f: jnp.where(seg_single, a, f), a_reg, fin))
     return out_sorted, fin
 
 
