@@ -1,7 +1,7 @@
 # Dev targets (the reference Makefile:1-15 has only release/docker; we add
 # the working set).
 
-.PHONY: test test-core proto bench docker lint cluster
+.PHONY: test test-core test-pallas proto bench docker lint cluster
 
 test:
 	python -m pytest tests/ -x -q
@@ -9,6 +9,11 @@ test:
 # per-commit run: everything except the @pytest.mark.slow soak/fuzz/e2e
 test-core:
 	python -m pytest tests/ -x -q -m "not slow"
+
+# the Pallas lowerings' differential suites (interpret mode on CPU):
+# per-op kernels + the fused serving-window megakernel vs the int64 oracle
+test-pallas:
+	python -m pytest tests/test_pallas.py tests/test_fused_megakernel.py -x -q
 
 proto:
 	cd gubernator_tpu/api/proto && protoc --python_out=. gubernator.proto peers.proto

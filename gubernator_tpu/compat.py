@@ -1,0 +1,58 @@
+"""JAX version compatibility shims.
+
+The codebase targets the current JAX API (``jax.shard_map`` with
+``check_vma``, ``jax.typeof`` aval inspection, ``ShapeDtypeStruct(vma=...)``)
+but must also run on the 0.4.x line, where ``shard_map`` still lives under
+``jax.experimental`` with the ``check_rep`` spelling, vma tags do not exist,
+and ``ShapeDtypeStruct`` has no ``vma`` parameter.  Everything
+version-dependent funnels through here so the call sites stay written
+against the modern API.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "typeof_vma", "shape_dtype_struct"]
+
+_HAS_NEW_SHARD_MAP = hasattr(jax, "shard_map")
+_HAS_TYPEOF = hasattr(jax, "typeof")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the replication-check flag under either of its
+    two historical names (``check_vma`` today, ``check_rep`` on 0.4.x).
+
+    On the 0.4.x fallback the check is forced OFF regardless of the caller:
+    that line's checker has no replication rule for ``while`` (every window
+    executable carries the replay ``lax.while_loop``), so ``check_rep=True``
+    raises NotImplementedError on the engine's default paths.  The check is
+    a trace-time safety net, not part of the computation — dropping it
+    changes nothing the executables produce."""
+    if _HAS_NEW_SHARD_MAP:
+        try:
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=check_vma)
+        except TypeError:
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=False)
+
+
+def typeof_vma(x):
+    """The vma tag of ``x``'s abstract type, or None where vma does not
+    exist (outside shard_map, under check_vma=False, or on 0.4.x)."""
+    if not _HAS_TYPEOF:
+        return None
+    return getattr(jax.typeof(x), "vma", None)
+
+
+def shape_dtype_struct(shape, dtype, vma=None):
+    """``jax.ShapeDtypeStruct`` that forwards ``vma`` only on JAX versions
+    whose constructor accepts it (a non-None vma can only have come from
+    ``typeof_vma`` on such a version)."""
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)

@@ -40,6 +40,7 @@ from gubernator_tpu.api.types import (
     RateLimitResp,
     millisecond_now,
 )
+from gubernator_tpu.compat import shard_map as _compat_shard_map
 from gubernator_tpu.ops import kernel
 from gubernator_tpu.ops.kernel import (
     BucketState,
@@ -1451,6 +1452,41 @@ def _use_compact32_xla() -> bool:
     return os.environ.get("GUBER_COMPACT32_XLA", "1") == "1"
 
 
+def _use_pallas_fused() -> bool:
+    """Opt-in FUSED Pallas serving window (GUBER_PALLAS_FUSED=1): the whole
+    compact window — decode, sort, segment prep, transitions, commit,
+    response encode — as ONE pallas_call (ops/pallas_kernel.py
+    window_step_fused) instead of the ~hundreds of executed kernels the
+    compact32-XLA drain lowers to.  Default off; adopted by bench.py's
+    parity-gated A/B.  Same read-at-build-time discipline as _use_pallas.
+    Takes precedence over GUBER_PALLAS at compact call sites; full-format
+    call sites are unaffected (their lanes may exceed the rebase range)."""
+    import os
+    return os.environ.get("GUBER_PALLAS_FUSED") == "1"
+
+
+def _recursion_guarded(fn):
+    """Wrap a compiled executable so every call runs under the Mosaic
+    recursion-limit guard (ops/pallas_kernel.py mosaic_recursion_guard).
+
+    Real-Mosaic lowering of the big fused window jaxpr recurses deeper than
+    CPython's default 1000 frames, and jax lowers lazily — at the FIRST CALL
+    of the jitted object, not at jit() time — so the guard must wrap the
+    call site.  Scoping it here (instead of the old module-import
+    setrecursionlimit side effect) keeps the process global untouched for
+    every embedder that never runs the Pallas path."""
+    from functools import wraps
+
+    from gubernator_tpu.ops.pallas_kernel import mosaic_recursion_guard
+
+    @wraps(fn)
+    def guarded(*args, **kwargs):
+        with mosaic_recursion_guard():
+            return fn(*args, **kwargs)
+
+    return guarded
+
+
 def _window_step_fn(mesh: Mesh, compact32: bool, pallas: bool,
                     c32xla: bool):
     """kernel.window_step, or its Pallas lowering under GUBER_PALLAS=1
@@ -1586,7 +1622,7 @@ def _compiled_step_impl(mesh: Mesh, pallas: bool):
 
     state_sharded = BucketState(*[P(SHARD_AXIS)] * 6)
     state_repl = BucketState(*[P()] * 6)
-    sharded = jax.shard_map(
+    sharded = _compat_shard_map(
         shard_fn,
         mesh=mesh,
         # the Pallas window kernel cannot carry vma tags through its
@@ -1611,17 +1647,19 @@ def _compiled_step_impl(mesh: Mesh, pallas: bool):
             GlobalConfig(*[P()] * 3),
         ),
     )
-    return jax.jit(sharded, donate_argnums=(0, 1, 2))
+    fn = jax.jit(sharded, donate_argnums=(0, 1, 2))
+    return _recursion_guarded(fn) if pallas else fn
 
 
 def _compiled_step_compact(mesh: Mesh):
     return _compiled_step_compact_impl(mesh, _use_pallas(),
-                                       _use_compact32_xla())
+                                       _use_compact32_xla(),
+                                       _use_pallas_fused())
 
 
 @lru_cache(maxsize=None)
 def _compiled_step_compact_impl(mesh: Mesh, pallas: bool,
-                                c32xla: bool):
+                                c32xla: bool, fused: bool = False):
     """The serving fast path: compact request/response wire format.
 
     Same computation as _compiled_step, but the regular-key window crosses
@@ -1633,9 +1671,21 @@ def _compiled_step_compact_impl(mesh: Mesh, pallas: bool,
     """
     def shard_fn(state, gstate, gcfg, packed, gbatch, gacc, upd, ups, now):
         st = BucketState(*jax.tree.map(lambda a: a[0], state))
-        bt = kernel.decode_batch(packed[0])
-        new_st, out = _window_step_fn(mesh, compact32=True, pallas=pallas,
-                                      c32xla=c32xla)(st, bt, now)
+        # The fused megakernel's in-kernel bitonic sort needs a power-of-two
+        # lane count; other widths fall back to the compact32-XLA drain at
+        # trace time (B is static).
+        B = packed.shape[-2]
+        if fused and (B & (B - 1)) == 0:
+            from gubernator_tpu.ops.pallas_kernel import window_step_fused
+            new_st, words, limits, _ = window_step_fused(
+                st, packed[0], now, interpret=_mesh_on_cpu(mesh))
+            enc = jnp.stack([words, limits], axis=-1)
+        else:
+            bt = kernel.decode_batch(packed[0])
+            new_st, out = _window_step_fn(mesh, compact32=True,
+                                          pallas=pallas,
+                                          c32xla=c32xla)(st, bt, now)
+            enc = kernel.encode_output_compact(out, now)
 
         gstate, gcfg = _apply_control(gstate, gcfg, upd, ups)
         gb = WindowBatch(*jax.tree.map(lambda a: a[0], gbatch))
@@ -1647,7 +1697,7 @@ def _compiled_step_compact_impl(mesh: Mesh, pallas: bool,
              gout.reset_time], axis=-1)
         return (
             BucketState(*jax.tree.map(expand, new_st)),
-            kernel.encode_output_compact(out, now)[None],
+            enc[None],
             gfused[None],
             new_g,
             gcfg,
@@ -1655,13 +1705,13 @@ def _compiled_step_compact_impl(mesh: Mesh, pallas: bool,
 
     state_sharded = BucketState(*[P(SHARD_AXIS)] * 6)
     state_repl = BucketState(*[P()] * 6)
-    sharded = jax.shard_map(
+    sharded = _compat_shard_map(
         shard_fn,
         mesh=mesh,
         # the Pallas window kernel cannot carry vma tags through its
         # interpret-mode while_loop (jnp.take drops them); vma checking is
         # an XLA-path-only invariant here
-        check_vma=not pallas,
+        check_vma=not (pallas or fused),
         in_specs=(
             state_sharded,
             state_repl,
@@ -1681,7 +1731,8 @@ def _compiled_step_compact_impl(mesh: Mesh, pallas: bool,
             GlobalConfig(*[P()] * 3),
         ),
     )
-    return jax.jit(sharded, donate_argnums=(0, 1, 2))
+    fn = jax.jit(sharded, donate_argnums=(0, 1, 2))
+    return _recursion_guarded(fn) if (pallas or fused) else fn
 
 
 @lru_cache(maxsize=None)
@@ -1714,12 +1765,13 @@ def _compiled_global_register(mesh: Mesh):
 
 def _compiled_pipeline_step(mesh: Mesh):
     return _compiled_pipeline_step_impl(mesh, _use_pallas(),
-                                        _use_compact32_xla())
+                                        _use_compact32_xla(),
+                                        _use_pallas_fused())
 
 
 @lru_cache(maxsize=None)
 def _compiled_pipeline_step_impl(mesh: Mesh, pallas: bool,
-                                 c32xla: bool):
+                                 c32xla: bool, fused: bool = False):
     """K compact serving windows in ONE device dispatch — the drain
     executable of the serving pipeline (core/pipeline.py).
 
@@ -1745,6 +1797,10 @@ def _compiled_pipeline_step_impl(mesh: Mesh, pallas: bool,
     def shard_fn(state, packed, nows):
         # Block shapes: state [1, C]; packed [K, 1, B, 2]; nows [K].
         st = BucketState(*jax.tree.map(lambda a: a[0], state))
+        # Fused megakernel needs a power-of-two lane count for its in-kernel
+        # bitonic sort; other widths fall back to compact32-XLA (B static).
+        B = packed.shape[-2]
+        use_fused = fused and (B & (B - 1)) == 0
 
         def body(st, xs):
             pk, now = xs
@@ -1755,7 +1811,31 @@ def _compiled_pipeline_step_impl(mesh: Mesh, pallas: bool,
             mism = jnp.any((out.limit != bt.limit) & (bt.slot >= 0))
             return st, (word, out.limit, mism)
 
-        st, (words, limits, mism) = lax.scan(body, st, (packed, nows))
+        if use_fused:
+            # decode, sort, prep, transitions, commit AND the word encode
+            # all happen inside ONE pallas_call per window — O(1) executed
+            # kernels instead of the XLA drain's per-op launches.  The
+            # arena converts to its i32 plane form ONCE per drain and the
+            # scan carries that form, so the O(C) conversion amortizes
+            # over all K windows.
+            from gubernator_tpu.ops.pallas_kernel import (
+                fused_state_from_planes,
+                fused_state_to_planes,
+                window_step_fused_planes,
+            )
+            on_cpu = _mesh_on_cpu(mesh)
+
+            def body32(st32, xs):
+                pk, now = xs
+                st32, word, limit, mism = window_step_fused_planes(
+                    st32, pk[0], now, interpret=on_cpu)
+                return st32, (word, limit, mism)
+
+            st32, (words, limits, mism) = lax.scan(
+                body32, fused_state_to_planes(st), (packed, nows))
+            st = fused_state_from_planes(st32)
+        else:
+            st, (words, limits, mism) = lax.scan(body, st, (packed, nows))
         expand = lambda a: a[None]
         return (
             BucketState(*jax.tree.map(expand, st)),
@@ -1766,17 +1846,18 @@ def _compiled_pipeline_step_impl(mesh: Mesh, pallas: bool,
 
     state_sharded = BucketState(*[P(SHARD_AXIS)] * 6)
     stackedP = P(None, SHARD_AXIS)
-    sharded = jax.shard_map(
+    sharded = _compat_shard_map(
         shard_fn,
         mesh=mesh,
         # the Pallas window kernel cannot carry vma tags through its
         # interpret-mode while_loop (jnp.take drops them); vma checking is
         # an XLA-path-only invariant here
-        check_vma=not pallas,
+        check_vma=not (pallas or fused),
         in_specs=(state_sharded, stackedP, P()),
         out_specs=(state_sharded, stackedP, stackedP, stackedP),
     )
-    return jax.jit(sharded, donate_argnums=(0,))
+    fn = jax.jit(sharded, donate_argnums=(0,))
+    return _recursion_guarded(fn) if (pallas or fused) else fn
 
 
 def _compiled_multi_step(mesh: Mesh):
@@ -1831,7 +1912,7 @@ def _compiled_multi_step_impl(mesh: Mesh, pallas: bool):
     state_sharded = BucketState(*[P(SHARD_AXIS)] * 6)
     state_repl = BucketState(*[P()] * 6)
     stackedP = P(None, SHARD_AXIS)
-    sharded = jax.shard_map(
+    sharded = _compat_shard_map(
         shard_fn,
         mesh=mesh,
         # the Pallas window kernel cannot carry vma tags through its
@@ -1856,4 +1937,5 @@ def _compiled_multi_step_impl(mesh: Mesh, pallas: bool):
             GlobalConfig(*[P()] * 3),
         ),
     )
-    return jax.jit(sharded, donate_argnums=(0, 1, 2))
+    fn = jax.jit(sharded, donate_argnums=(0, 1, 2))
+    return _recursion_guarded(fn) if pallas else fn

@@ -405,6 +405,64 @@ def uniform_closed_form(st: _Reg, fresh0, h0, l0, d0, a0, pos, seg_len, now):
     return ff_reg, ff_out
 
 
+def segment_structure(s_slot, s_valid, s_init):
+    """Segment indexing over a slot-sorted window: virtual-segment starts,
+    per-lane segment start index / position / length, and the commit mask
+    (the lanes whose final register may land in the arena).
+
+    Segments are VIRTUAL: they break at slot changes AND at is_init lanes
+    (see window_prep's docstring for why).  Written in kernel-safe
+    primitives only — shifted compares via `jnp.take`, `lax.cummax` /
+    `lax.cummin` scans — because this exact function also runs INSIDE the
+    fused Pallas megakernel (ops/pallas_kernel.py window_step_fused), where
+    Mosaic has no concatenate-shift or scatter forms.  Sharing the one
+    implementation is what keeps the XLA and fused paths from drifting.
+
+    Returns (seg_start, seg_start_idx, pos, seg_len, commit_mask).
+    """
+    B = s_slot.shape[0]
+    idx = lax.iota(I32, B)
+    prev_slot = jnp.take(s_slot, jnp.maximum(idx - 1, 0))
+    phys_start = (idx == 0) | (s_slot != prev_slot)
+    seg_start = phys_start | (s_init & s_valid)
+    seg_start_idx = lax.cummax(jnp.where(seg_start, idx, jnp.int32(0)))
+    pos = idx - seg_start_idx
+    # next segment start at-or-after lane i+1 (B when none): lane i's value
+    # is min over j > i of {j if start[j] else B}, via a reverse cummin of
+    # the shifted-start lattice
+    nxt = jnp.minimum(idx + 1, B - 1)
+
+    def _next_boundary(start):
+        shifted = jnp.where(jnp.take(start, nxt) & (idx < B - 1),
+                            idx + 1, jnp.int32(B))
+        return lax.cummin(shifted, reverse=True)
+
+    next_start = _next_boundary(seg_start)
+    seg_len = next_start - seg_start_idx
+    # a virtual segment is its slot's LAST (→ the one that commits) iff no
+    # further virtual start precedes the next physical slot change
+    next_phys = _next_boundary(phys_start)
+    commit_mask = seg_start & s_valid & (next_start >= next_phys)
+    return seg_start, seg_start_idx, pos, seg_len, commit_mask
+
+
+def segment_all(ok, seg_start_idx, seg_len):
+    """Per-lane: does EVERY lane of my segment satisfy `ok`?  Replicated to
+    all lanes of the segment.
+
+    Cumsum range-count instead of a scatter-min (`.at[seg].min`): counts the
+    failing lanes inside [seg_start, seg_start+len) from an inclusive
+    prefix sum — gather-only, so the SAME code runs in window_prep's XLA
+    trace and inside the fused Pallas megakernel.
+    """
+    bad = (~ok).astype(I32)
+    csum = jnp.cumsum(bad)
+    seg_end = seg_start_idx + seg_len - 1
+    n_bad = (jnp.take(csum, seg_end) - jnp.take(csum, seg_start_idx)
+             + jnp.take(bad, seg_start_idx))
+    return n_bad == 0
+
+
 class WindowPrep(NamedTuple):
     """Everything window_step derives from a window before the transition
     math: sorted request lanes, segment structure, gathered registers, and
@@ -480,28 +538,8 @@ def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
     s_init = s_req[:, 4].astype(jnp.bool_)
     s_agg = s_req[:, 5].astype(jnp.bool_)
 
-    idx = jnp.arange(B, dtype=I32)
-    phys_start = jnp.concatenate(
-        [jnp.ones((1,), jnp.bool_), s_slot[1:] != s_slot[:-1]])
-    seg_start = phys_start | (s_init & s_valid)
-    seg_start_idx = lax.cummax(jnp.where(seg_start, idx, jnp.int32(0)))
-    pos = idx - seg_start_idx
-    # seg_len[i] = length of i's segment: next segment start minus own start
-    shifted = jnp.concatenate([
-        jnp.where(seg_start[1:], idx[1:], jnp.int32(B)),
-        jnp.full((1,), B, I32),
-    ])
-    next_start = jnp.flip(lax.cummin(jnp.flip(shifted)))
-    seg_len = next_start - seg_start_idx
-    # next PHYSICAL boundary after each lane: a virtual segment is its
-    # slot's last (→ the one that commits) iff no further virtual start
-    # precedes the next slot change
-    shifted_p = jnp.concatenate([
-        jnp.where(phys_start[1:], idx[1:], jnp.int32(B)),
-        jnp.full((1,), B, I32),
-    ])
-    next_phys = jnp.flip(lax.cummin(jnp.flip(shifted_p)))
-    commit_mask = seg_start & s_valid & (next_start >= next_phys)
+    seg_start, seg_start_idx, pos, seg_len, commit_mask = segment_structure(
+        s_slot, s_valid, s_init)
 
     # Registers: the live state of each segment's bucket.  Every lane of a
     # segment gathers the SAME slot, so these are replicated per segment.
@@ -538,9 +576,7 @@ def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
         (s_hits == h0) & (s_limit == l0) & (s_duration == d0)
         & (s_algo == a0) & ~s_agg
     )
-    seg_ok = jnp.ones_like(s_algo).at[seg_start_idx].min(
-        lane_ok.astype(I32), mode="drop")
-    seg_uniform = (seg_ok[seg_start_idx] == 1) & (h0 > 0)
+    seg_uniform = segment_all(lane_ok, seg_start_idx, seg_len) & (h0 > 0)
     # A singleton non-uniform segment — a folded (aggregated-run) lane
     # owning its slot this window, or a lone hits=0 peek — is closed-form
     # too: its one replay round would read exactly the window-entry

@@ -1,56 +1,60 @@
 """Pallas TPU kernels for the rate-limit hot passes.
 
-Two lowerings, chosen by what actually profits from hand-scheduling on TPU
-(everything here is gated behind GUBER_PALLAS=1; the engine defaults to the
-XLA implementations, which are semantically identical):
+Three lowerings, chosen by what actually profits from hand-scheduling on TPU
+(everything here is gated behind env flags; the engine defaults to the XLA
+implementations, which are semantically identical):
 
-1. `global_apply_pallas` — the GLOBAL aggregate-apply: a pure elementwise
-   transition over the whole replicated arena, grid-blocked through VMEM.
+1. `global_apply_pallas` (GUBER_PALLAS=1) — the GLOBAL aggregate-apply: a
+   pure elementwise transition over the whole replicated arena,
+   grid-blocked through VMEM.
 
-2. `window_step_pallas` — the per-shard serving window.  The WINDOW MATH
-   (closed-form uniform segments + the duplicate-key replay rounds) runs as
-   ONE VMEM-resident kernel over the [B] lane vectors, with the replay's
-   register state formulated REPLICATED-per-lane so each round is
-   elementwise + one vector gather (no scatters in the kernel).  The
-   argsort and the arena gather/scatter stay in XLA deliberately: Mosaic
-   has no sort primitive, and per-lane DMAs into a 2^27-slot HBM arena
-   lose to XLA's native gather/scatter — a "full" Pallas lowering of those
-   ops would be slower, not faster.
+2. `window_step_pallas` (GUBER_PALLAS=1) — the per-shard serving window.
+   The WINDOW MATH (closed-form uniform segments + the duplicate-key replay
+   rounds) runs as ONE VMEM-resident kernel over the [B] lane vectors, with
+   the replay's register state formulated REPLICATED-per-lane so each round
+   is elementwise + one vector gather (no scatters in the kernel).  The
+   argsort and the arena gather/scatter stay in XLA.
 
-Both kernel bodies *reuse* `kernel.transition` / `kernel.uniform_closed_form`
-— the exact branch ladders that mirror reference algorithms.go:24-186 — so
-the Pallas and XLA paths cannot drift semantically, and the fuzz oracle
-(tests/pyref.py) pins both.
+3. `window_step_fused` (GUBER_PALLAS_FUSED=1) — the FULL compact serving
+   window as ONE pallas_call: wire decode, slot sort (in-kernel bitonic),
+   segment prep, uniform/replay transitions, the replay-free fold path,
+   arena commit (one write per touched slot) and the compact response
+   encode all inside a single kernel whose arena planes are aliased
+   in/out.  This is the per-kernel-overhead killer: the compact32-XLA
+   drain lowers a K-window dispatch to hundreds of executed kernels
+   (gathers, scatters, sort passes, elementwise stages — each a measured
+   fixed launch cost on remote runtimes, BENCH_NOTES round 4), where the
+   fused form executes O(1) kernels per window.  Everything runs in
+   rebased int32 (arena i64 timestamps enter as (lo, hi) half planes and
+   are rebased with explicit borrow/carry pair arithmetic), which is the
+   only form Mosaic accepts on real TPU — no 64-bit vector types.
+
+All kernel bodies *reuse* `kernel.transition` / `kernel.uniform_closed_form`
+/ `_window_math` / `kernel.segment_structure` — the exact branch ladders
+that mirror reference algorithms.go:24-186 — so the Pallas and XLA paths
+cannot drift semantically, and the fuzz oracle (tests/pyref.py) plus the
+int64 kernel (ops/kernel.py, kept as the bit-exact oracle) pin all of them.
 
 State is int64 (ms-epoch timestamps + proto-contract counters).  Mosaic's
 int64 support on real TPU is not yet validated in this environment (the
 device tunnel was down when this was written), so the engine keeps the XLA
-path by default; enable with GUBER_PALLAS=1 or interpret=True (CPU tests run
+path by default; enable with the env flags or interpret=True (CPU tests run
 the kernels in interpret mode and pin them against the XLA implementation).
 """
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import sys
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-# Lowering the kernel's fused window-math jaxpr (closed-form ladder +
-# replay loop as ONE Mosaic kernel) recurses past CPython's default 1000
-# frames inside jax's mlir lowering on real TPU (observed: RecursionError
-# during the OUTER jit's compile, at first call of the compiled step —
-# interpret mode on CPU stays shallower and never trips it).  The bump
-# must be process-global: the lowering runs at unpredictable first-call
-# sites, not under any lexical scope here.  The jaxpr nesting is finite
-# (a few thousand frames), and CPython 3.12 heap-allocates Python-to-
-# Python frames, so the higher ceiling does not threaten the C stack.
-if sys.getrecursionlimit() < 20000:
-    sys.setrecursionlimit(20000)
-
+from gubernator_tpu.compat import shape_dtype_struct, typeof_vma
 from gubernator_tpu.ops import kernel
 from gubernator_tpu.ops.kernel import (
     BucketState,
@@ -64,6 +68,32 @@ from gubernator_tpu.ops.kernel import (
 
 # lanes per grid step; arenas are sized in powers of two >= 1024
 BLOCK = 1024
+
+
+@contextlib.contextmanager
+def mosaic_recursion_guard(limit: int = 20000):
+    """Temporarily raise the recursion ceiling around a Mosaic lowering.
+
+    Lowering the fused window-math jaxpr (closed-form ladder + replay loop
+    as ONE Mosaic kernel) recurses past CPython's default 1000 frames
+    inside jax's mlir lowering on real TPU (observed: RecursionError during
+    the OUTER jit's compile, at first call of the compiled step — interpret
+    mode on CPU stays shallower and never trips it).  The lowering runs at
+    the first CALL of the engine's compiled executables, so the engine
+    wraps those call sites in this guard (core/engine.py _recursion_guarded)
+    rather than bumping the limit process-globally at import — an import
+    side effect would leak a 20x ceiling into every embedding application
+    (ADVICE.md #1).  The jaxpr nesting is finite (a few thousand frames),
+    and CPython 3.12 heap-allocates Python-to-Python frames, so the
+    temporary ceiling does not threaten the C stack.
+    """
+    prev = sys.getrecursionlimit()
+    if prev < limit:
+        sys.setrecursionlimit(limit)
+    try:
+        yield
+    finally:
+        sys.setrecursionlimit(prev)
 
 
 def _apply_kernel(now_ref, limit_ref, dur_ref, rem_ref, ts_ref, exp_ref,
@@ -107,8 +137,8 @@ def global_apply_pallas(state: BucketState, cfg: GlobalConfig,
     # the global arena is replicated across the mesh, so under shard_map
     # with check_vma the outputs vary over no axes (vma=()); with check_vma
     # off (the engine's Pallas mode) or outside shard_map, vma is None
-    vma = getattr(jax.typeof(state.limit), "vma", None)
-    sds = lambda dt: jax.ShapeDtypeStruct((G,), dt, vma=vma)
+    vma = typeof_vma(state.limit)
+    sds = lambda dt: shape_dtype_struct((G,), dt, vma=vma)
     out_shapes = [sds(jnp.int64)] * 5 + [sds(jnp.int32)]
     outs = pl.pallas_call(
         _apply_kernel,
@@ -330,8 +360,8 @@ def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
     # survive the kernel's interpret-mode while_loop), in which case typeof
     # has no vma and None is correct.
     if use_pallas:
-        vma = getattr(jax.typeof(batch.slot), "vma", None)
-        sds = lambda dt: jax.ShapeDtypeStruct((B,), dt, vma=vma)
+        vma = typeof_vma(batch.slot)
+        sds = lambda dt: shape_dtype_struct((B,), dt, vma=vma)
         spec = pl.BlockSpec((B,), lambda: (0,))
         sspec = pl.BlockSpec((1,), lambda: (0,))
         outs = pl.pallas_call(
@@ -388,3 +418,302 @@ def window_step_compact32_xla(state: BucketState, batch: WindowBatch, now
     """
     return window_step_pallas(state, batch, now, compact32=True,
                               use_pallas=False)
+
+
+# ---- the fused serving-window megakernel --------------------------------
+
+_REBASE_LIM = 2**31 - 16
+
+
+def _u32(x):
+    return lax.bitcast_convert_type(x, jnp.uint32)
+
+
+def _pair_rebase(t_lo, t_hi, n_lo, n_hi):
+    """clip(t - now, -REBASE_LIM, REBASE_LIM) on (lo, hi) i32 halves.
+
+    Exact vs the int64 form for every input: the borrow subtract yields the
+    wrapped i64 difference's halves; when it fits int32 the clip sees the
+    true difference, otherwise the hi half's sign picks the saturation end
+    — identical to clipping the i64 value (verified over random i64s in
+    tests/test_fused_megakernel.py)."""
+    d_lo = t_lo - n_lo
+    borrow = (_u32(t_lo) < _u32(n_lo)).astype(I32)
+    d_hi = t_hi - n_hi - borrow
+    fits = d_hi == (d_lo >> 31)
+    lim = jnp.int32(_REBASE_LIM)
+    return jnp.where(fits, jnp.clip(d_lo, -lim, lim),
+                     jnp.where(d_hi < 0, -lim, lim))
+
+
+def _pair_reabs(rel, n_lo, n_hi):
+    """now + rel on (lo, hi) i32 halves (exact i64 add: sign-extended rel,
+    carry from unsigned lo overflow)."""
+    a_lo = n_lo + rel
+    carry = (_u32(a_lo) < _u32(rel)).astype(I32)
+    a_hi = n_hi + (rel >> 31) + carry
+    return a_lo, a_hi
+
+
+def _bitonic_sort_by_slot(sort_key):
+    """(sorted_key, order) for a power-of-two lane vector — the in-kernel
+    equivalent of `jnp.argsort(sort_key)` + gather.
+
+    Lexicographic (key, lane) comparisons make the network STABLE despite
+    bitonic networks not being: the lane index breaks every tie in arrival
+    order, which the replay semantics require (duplicate hits to one slot
+    must apply in arrival order).  XOR-partner exchanges are two vector
+    gathers + elementwise selects per stage, log2(B)·(log2(B)+1)/2 stages,
+    all Mosaic-legal — no sort primitive needed."""
+    B = sort_key.shape[0]
+    lane = lax.iota(I32, B)
+    key, idx = sort_key, lane
+    k = 2
+    while k <= B:
+        j = k // 2
+        while j >= 1:
+            partner = lane ^ j
+            p_key = jnp.take(key, partner)
+            p_idx = jnp.take(idx, partner)
+            ascending = (lane & k) == 0
+            less = (key < p_key) | ((key == p_key) & (idx < p_idx))
+            is_lower = (lane & j) == 0
+            keep = jnp.where(is_lower, less == ascending, less != ascending)
+            key = jnp.where(keep, key, p_key)
+            idx = jnp.where(keep, idx, p_idx)
+            j //= 2
+        k *= 2
+    return key, idx
+
+
+class FusedState32(NamedTuple):
+    """The bucket arena as i32 planes — the form the fused megakernel
+    reads/writes in place (aliased pallas_call operands).
+
+    limit/duration/remaining are plain truncations: the compact serving
+    path guarantees their stored values are inside the compact caps
+    (< 2^31, engine._compact_eligible), so the low half IS the value.
+    tstamp/expire are ms-epoch int64s that do NOT fit 32 bits; they travel
+    as exact (lo, hi) bitcast halves and only ever get rebased/committed
+    through the pair helpers above.  The pipeline drain converts once per
+    K-window dispatch and carries THIS form through the scan, so the O(C)
+    plane conversion is amortized over the whole drain."""
+
+    limit: jax.Array      # i32[C]
+    duration: jax.Array   # i32[C]
+    remaining: jax.Array  # i32[C]
+    t_lo: jax.Array       # i32[C]
+    t_hi: jax.Array       # i32[C]
+    e_lo: jax.Array       # i32[C]
+    e_hi: jax.Array       # i32[C]
+    algo: jax.Array       # i32[C]
+
+
+def fused_state_to_planes(state: BucketState) -> FusedState32:
+    tp = lax.bitcast_convert_type(state.tstamp, I32)
+    ep = lax.bitcast_convert_type(state.expire, I32)
+    return FusedState32(
+        limit=state.limit.astype(I32),
+        duration=state.duration.astype(I32),
+        remaining=state.remaining.astype(I32),
+        t_lo=tp[:, 0], t_hi=tp[:, 1],
+        e_lo=ep[:, 0], e_hi=ep[:, 1],
+        algo=state.algo)
+
+
+def fused_state_from_planes(st32: FusedState32) -> BucketState:
+    pair64 = lambda lo, hi: lax.bitcast_convert_type(
+        jnp.stack([lo, hi], axis=-1), I64)
+    return BucketState(
+        limit=st32.limit.astype(I64),
+        duration=st32.duration.astype(I64),
+        remaining=st32.remaining.astype(I64),
+        tstamp=pair64(st32.t_lo, st32.t_hi),
+        expire=pair64(st32.e_lo, st32.e_hi),
+        algo=st32.algo)
+
+
+def _fused_kernel(now_ref, req_ref,
+                  a_lim, a_dur, a_rem, a_tlo, a_thi, a_elo, a_ehi, a_algo,
+                  o_lim, o_dur, o_rem, o_tlo, o_thi, o_elo, o_ehi, o_algo,
+                  o_wlo, o_whi, o_rlimit, o_mism):
+    """The whole compact serving window as one kernel body.
+
+    Stages (each the i32-halves image of the XLA path's stage, same order):
+    decode (kernel.decode_batch) → sort (stable bitonic ≡ jnp.argsort) →
+    segment prep (kernel.segment_structure / segment_all — the SAME
+    functions window_prep calls) → window math (_window_math — the same
+    body the split Pallas/XLA paths run) → commit (kernel.window_commit's
+    one-write-per-slot scatter, race-free form) → response word encode
+    (kernel.encode_output_word) + unsort.  The o_* arena planes alias the
+    a_* inputs, so the arena never leaves device memory."""
+    B = req_ref.shape[0]
+    C = a_lim.shape[0]
+    n_lo = now_ref[0]
+    n_hi = now_ref[1]
+    req = req_ref[:]
+    w0lo, w0hi, w1lo, w1hi = req[:, 0], req[:, 1], req[:, 2], req[:, 3]
+
+    # ---- decode: kernel.decode_batch, reformulated on i32 halves ----
+    # (bit 32 group of the i64 word lands in the hi half's low bits; the
+    # hits mask clears the arithmetic-shift sign smear)
+    slot_raw = w0lo - 1
+    hits = (w0hi >> 2) & jnp.int32(kernel.COMPACT_MAX_HITS - 1)
+    limit = w1lo
+    duration = w1hi & jnp.int32(0x7FFFFFFF)
+    algo = (w0hi >> 1) & 1
+    is_init = (w0hi & 1) == 1
+
+    # ---- window_prep in sorted, rebased-i32 form ----
+    valid = slot_raw >= 0
+    agg = valid & ((slot_raw & jnp.int32(kernel.AGG_SLOT_BIT)) != 0)
+    slot_clean = jnp.where(agg, slot_raw & jnp.int32(~kernel.AGG_SLOT_BIT),
+                           slot_raw)
+    sort_key = jnp.where(valid, slot_clean, jnp.int32(2**31 - 1))
+    s_slot, order = _bitonic_sort_by_slot(sort_key)
+    s_valid = jnp.take(valid, order)
+    s_hits = jnp.take(hits, order)
+    s_limit = jnp.take(limit, order)
+    s_duration = jnp.take(duration, order)
+    s_algo = jnp.take(algo, order)
+    s_init = jnp.take(is_init, order)
+    s_agg = jnp.take(agg, order)
+
+    seg_start, seg_start_idx, pos, seg_len, commit_mask = (
+        kernel.segment_structure(s_slot, s_valid, s_init))
+
+    g = jnp.clip(s_slot, 0, C - 1)
+    raw_lim = a_lim[g]
+    raw_dur = a_dur[g]
+    raw_rem = a_rem[g]
+    raw_tlo = a_tlo[g]
+    raw_thi = a_thi[g]
+    raw_elo = a_elo[g]
+    raw_ehi = a_ehi[g]
+    raw_algo = a_algo[g]
+    cur = _Reg(limit=raw_lim, duration=raw_dur, remaining=raw_rem,
+               tstamp=_pair_rebase(raw_tlo, raw_thi, n_lo, n_hi),
+               expire=_pair_rebase(raw_elo, raw_ehi, n_lo, n_hi),
+               algo=raw_algo)
+    # rebased image of prep's `s_init | (cur.expire < now)`: the clip
+    # preserves the difference's sign, so rel < 0 ⇔ expire < now
+    cur_fresh = s_init | (cur.expire < 0)
+
+    h0 = jnp.take(s_hits, seg_start_idx)
+    l0 = jnp.take(s_limit, seg_start_idx)
+    d0 = jnp.take(s_duration, seg_start_idx)
+    a0 = jnp.take(s_algo, seg_start_idx)
+    fresh_seg = jnp.take(cur_fresh, seg_start_idx)
+    lane_ok = ((s_hits == h0) & (s_limit == l0) & (s_duration == d0)
+               & (s_algo == a0) & ~s_agg)
+    seg_uniform = (kernel.segment_all(lane_ok, seg_start_idx, seg_len)
+                   & (h0 > 0))
+    seg_single = s_valid & ~seg_uniform & (seg_len == 1)
+    max_pos = jnp.max(jnp.where(s_valid & ~seg_uniform & ~seg_single, pos,
+                                jnp.int32(-1)))
+
+    # ---- the window math: the SAME body as the split paths ----
+    out_sorted, fin = _window_math(
+        jnp.int32(0), max_pos, s_valid, s_hits, s_limit, s_duration,
+        s_algo, s_agg, pos, seg_len, seg_start_idx, seg_uniform,
+        h0, l0, d0, a0, fresh_seg, cur)
+
+    # ---- commit: one write per touched slot, race-free scatter form ----
+    # window_commit redirects non-commit lanes to slot C (out of range,
+    # mode="drop"); Pallas refs have no drop mode, so instead every
+    # non-commit lane REJOINS the first committing lane's write — same
+    # target, same value, so duplicate-scatter order can't matter.  With
+    # zero commit lanes (all-pad window) every lane rewrites the raw
+    # current value of lane 0's row: a no-op.
+    f_tlo, f_thi = _pair_reabs(fin.tstamp, n_lo, n_hi)
+    f_elo, f_ehi = _pair_reabs(fin.expire, n_lo, n_hi)
+    any_commit = jnp.any(commit_mask)
+    safe = jnp.argmax(commit_mask).astype(I32)
+    tgt = jnp.where(commit_mask, g, jnp.take(g, safe))
+
+    def commit_plane(ref, fin_vals, raw_vals):
+        cand = jnp.where(any_commit, fin_vals, raw_vals)
+        ref[tgt] = jnp.where(commit_mask, fin_vals, jnp.take(cand, safe))
+
+    commit_plane(o_lim, fin.limit, raw_lim)
+    commit_plane(o_dur, fin.duration, raw_dur)
+    commit_plane(o_rem, fin.remaining, raw_rem)
+    commit_plane(o_tlo, f_tlo, raw_tlo)
+    commit_plane(o_thi, f_thi, raw_thi)
+    commit_plane(o_elo, f_elo, raw_elo)
+    commit_plane(o_ehi, f_ehi, raw_ehi)
+    commit_plane(o_algo, fin.algo, raw_algo)
+
+    # ---- response encode (kernel.encode_output_word image) + unsort ----
+    # reset word: enc 0 iff the ABSOLUTE reset is 0 — the leaky no-reset
+    # sentinel (rel == 0 on a leaky lane) or an absolute time that lands
+    # exactly on zero; otherwise clip(rel, 0, 2^31-2) + 1, exact because
+    # reset64 - now == rel in int64
+    leaky0 = (s_algo == kernel.LEAKY_BUCKET) & (out_sorted.reset_time == 0)
+    ab_lo, ab_hi = _pair_reabs(out_sorted.reset_time, n_lo, n_hi)
+    reset_zero = leaky0 | ((ab_lo == 0) & (ab_hi == 0))
+    enc = jnp.where(reset_zero, jnp.int32(0),
+                    jnp.clip(out_sorted.reset_time, 0,
+                             jnp.int32(2**31 - 2)) + 1)
+    w_lo = (out_sorted.status << 31) | jnp.maximum(out_sorted.remaining, 0)
+    o_wlo[order] = w_lo
+    o_whi[order] = enc
+    o_rlimit[order] = out_sorted.limit
+    o_mism[0] = jnp.any((out_sorted.limit != s_limit)
+                        & s_valid).astype(I32)
+
+
+def window_step_fused_planes(st32: FusedState32, packed, now, *,
+                             interpret: bool = False):
+    """One compact serving window as ONE pallas_call over the plane-form
+    arena.  Returns (new_st32, words i64[B], limits i64[B], mism bool) —
+    `words` is exactly kernel.encode_output_word(out, now) and `limits`
+    the stored-limit response plane, matching the pipeline drain's wire.
+
+    Exactness contract: identical to decode_batch → window_step (the int64
+    oracle) → encode_output_word under the compact wire caps plus
+    arena-written-under-caps — the same contract window_step_compact32_xla
+    carries, pinned by tests/test_fused_megakernel.py differentials.
+    """
+    B = packed.shape[0]
+    C = st32.limit.shape[0]
+    assert B & (B - 1) == 0, "fused megakernel needs power-of-two lanes"
+    now = jnp.asarray(now, I64)
+    req32 = lax.bitcast_convert_type(packed, I32).reshape(B, 4)
+    now32 = lax.bitcast_convert_type(now.reshape((1,)), I32).reshape((2,))
+
+    vma = typeof_vma(packed)
+    lane_sds = lambda shape: shape_dtype_struct(shape, I32, vma=vma)
+    plane_sds = lambda: shape_dtype_struct((C,), I32,
+                                           vma=typeof_vma(st32.limit))
+    bspec = pl.BlockSpec((B,), lambda: (0,))
+    aspec = pl.BlockSpec(memory_space=pl.ANY)
+    outs = pl.pallas_call(
+        _fused_kernel,
+        in_specs=[pl.BlockSpec((2,), lambda: (0,)),
+                  pl.BlockSpec((B, 4), lambda: (0, 0))] + [aspec] * 8,
+        out_specs=[aspec] * 8 + [bspec] * 3
+        + [pl.BlockSpec((1,), lambda: (0,))],
+        out_shape=[plane_sds() for _ in range(8)]
+        + [lane_sds((B,)) for _ in range(3)] + [lane_sds((1,))],
+        # arena planes update in place: inputs 2..9 alias outputs 0..7
+        input_output_aliases={i + 2: i for i in range(8)},
+        interpret=interpret,
+    )(now32, req32, *st32)
+    new32 = FusedState32(*outs[:8])
+    words = lax.bitcast_convert_type(
+        jnp.stack([outs[8], outs[9]], axis=-1), I64)
+    limits = outs[10].astype(I64)
+    return new32, words, limits, outs[11][0] != 0
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def window_step_fused(state: BucketState, packed, now, *,
+                      interpret: bool = False):
+    """BucketState-in/BucketState-out wrapper around the fused megakernel
+    (single-window call sites).  The pipeline drain avoids the per-window
+    O(C) plane conversion by carrying FusedState32 through its scan and
+    calling window_step_fused_planes directly."""
+    st32, words, limits, mism = window_step_fused_planes(
+        fused_state_to_planes(state), packed, now, interpret=interpret)
+    return fused_state_from_planes(st32), words, limits, mism

@@ -1,8 +1,10 @@
 """A/B: serving window executable, XLA vs Pallas compact32, on real TPU.
 
-Run twice (fresh process each — executables cache per (mesh, pallas)):
-    python scripts/probe_pallas_ab.py            # XLA path
-    GUBER_PALLAS=1 python scripts/probe_pallas_ab.py   # compact32 Pallas
+Run once per arm (fresh process each — executables cache per (mesh, flags)):
+    python scripts/probe_pallas_ab.py                        # compact32 XLA
+    GUBER_COMPACT32_XLA=0 python scripts/probe_pallas_ab.py  # int64 XLA
+    GUBER_PALLAS=1 python scripts/probe_pallas_ab.py         # per-window Pallas
+    GUBER_PALLAS_FUSED=1 python scripts/probe_pallas_ab.py   # fused megakernel
 
 Measures the honest per-window cost by the K-stack slope (one dispatch,
 internal lax.scan, one final fetch; K=1 vs K=9), plus functional parity of
@@ -34,7 +36,11 @@ KHI = int(os.environ.get("GUBER_PROBE_KHI", "9"))
 REPS = int(os.environ.get("GUBER_PROBE_REPS", "8"))
 now0 = 1_700_000_000_000
 devs = jax.devices()
-if os.environ.get("GUBER_PALLAS") == "1":
+# Mode ladder mirrors the engine's dispatch precedence (fused > per-window
+# Pallas > compact32-XLA > int64-XLA); each arm needs a fresh process.
+if os.environ.get("GUBER_PALLAS_FUSED") == "1":
+    mode = "pallas-fused"
+elif os.environ.get("GUBER_PALLAS") == "1":
     mode = "pallas-compact32"
 elif os.environ.get("GUBER_COMPACT32_XLA", "1") == "1":
     mode = "xla-compact32"

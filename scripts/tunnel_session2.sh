@@ -27,7 +27,12 @@ run() { # name timeout cmd...
   return $rc
 }
 
+# All serving-lowering arms run back to back in THIS session so the
+# comparison shares one tunnel/load regime (ADVICE.md: an XLA baseline
+# recorded in a previous session is not comparable).
+run pallas_ab_xla 1200 python scripts/probe_pallas_ab.py
 run pallas_ab 1200 env GUBER_PALLAS=1 python scripts/probe_pallas_ab.py
+run pallas_ab_fused 1200 env GUBER_PALLAS_FUSED=1 python scripts/probe_pallas_ab.py
 run pallas_cert 1200 env GUBER_PALLAS=1 python scripts/onchip_pallas_suite.py
 run bisect2 1200 python scripts/probe_bisect2.py
 run e2e_conc 1200 python scripts/probe_e2e_conc.py
@@ -37,7 +42,8 @@ run bench 1300 python bench.py
 {
   echo "# TPU session2 digest ($(date -u +%FT%TZ))"
   echo
-  for f in pallas_ab pallas_cert bisect2 e2e_conc trace bench; do
+  for f in pallas_ab_xla pallas_ab pallas_ab_fused pallas_cert bisect2 \
+           e2e_conc trace bench; do
     if [ -f "$OUT/$f.out" ]; then
       echo "## $f"
       grep -E "ms/window|ms/dispatch|per-window|parity|CERTIFIED|MISMATCH|decisions|tier|stale|error|FAILED|rc=" \
