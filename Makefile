@@ -1,7 +1,7 @@
 # Dev targets (the reference Makefile:1-15 has only release/docker; we add
 # the working set).
 
-.PHONY: test test-core test-pallas proto bench docker lint cluster
+.PHONY: test test-core test-pallas test-mesh-fused proto bench docker lint cluster
 
 test:
 	python -m pytest tests/ -x -q
@@ -14,6 +14,12 @@ test-core:
 # per-op kernels + the fused serving-window megakernel vs the int64 oracle
 test-pallas:
 	python -m pytest tests/test_pallas.py tests/test_fused_megakernel.py -x -q
+
+# the sharded fused-serving differential suite (forced 8-device CPU mesh):
+# composed GLOBAL drain, fused-vs-legacy parity, jaxpr kernel census.
+# Part of tier-1 (`test-core` picks it up too); this target runs just the slice.
+test-mesh-fused:
+	python -m pytest tests/ -x -q -m "mesh_fused and not slow"
 
 proto:
 	cd gubernator_tpu/api/proto && protoc --python_out=. gubernator.proto peers.proto

@@ -855,14 +855,19 @@ def child_main():
     def pick_pallas(result, deadline):
         """On-chip serving-lowering A/B in SUBPROCESSES (same pre-init
         slot as the stack-depth probe; executables cache per (mesh,
-        flags), so each arm needs a fresh process).  Three arms:
+        flags), so each arm needs a fresh process).  Four arms:
         int64-XLA (GUBER_COMPACT32_XLA=0), compact32-XLA (the proven
-        default), and the fused Pallas megakernel (GUBER_PALLAS_FUSED=1).
-        The fastest arm serves the tiers iff it ran ON TPU, is
-        word-exact, beats the compact32-XLA baseline by >=10%, AND the
-        baseline itself sits above a 1.0ms/window noise floor — below
-        that the quick-probe K-slope spread exceeds 10%, so a relative
-        "win" is indistinguishable from jitter.  Explicit GUBER_PALLAS /
+        default), the fused Pallas megakernel (GUBER_PALLAS_FUSED=1),
+        and the mesh composed drain (fused megakernel under shard_map
+        across all local devices, one GLOBAL psum per drain —
+        GUBER_PROBE_SHARDS spreads the probe mesh).  Each arm also
+        reports its drain executable's jaxpr kernel census, recorded
+        per arm in the BENCH json (pallas_ab_census).  The fastest arm
+        serves the tiers iff it ran ON TPU, is word-exact, beats the
+        compact32-XLA baseline by >=10%, AND the baseline itself sits
+        above a 1.0ms/window noise floor — below that the quick-probe
+        K-slope spread exceeds 10%, so a relative "win" is
+        indistinguishable from jitter.  Explicit GUBER_PALLAS /
         GUBER_PALLAS_FUSED / GUBER_COMPACT32_XLA in the env win either
         way; a failed non-baseline arm just drops out of the race.
         `deadline` (perf_counter) is shared with pick_stack_depth so the
@@ -897,18 +902,29 @@ def child_main():
                 # probe fell back to CPU: interpret-mode smoke timings
                 # must not drive (or be recorded as) a TPU choice
                 raise RuntimeError("probe ran on cpu, not applied")
-            return max(float(m.group(1)), 0.01), "EXACT" in text
+            # per-arm jaxpr kernel census (telemetry; absent on a census
+            # failure — the timing and parity gates still stand)
+            cm = re.search(r"census:\s+(\d+) kernels over (\d+) windows",
+                           text)
+            census = (round(int(cm.group(1)) / int(cm.group(2)), 1)
+                      if cm else None)
+            return max(float(m.group(1)), 0.01), "EXACT" in text, census
 
         ARMS = (("c32xla", {}),
                 ("int64", {"GUBER_COMPACT32_XLA": "0"}),
-                ("fused", {"GUBER_PALLAS_FUSED": "1"}))
+                ("fused", {"GUBER_PALLAS_FUSED": "1"}),
+                ("mesh_fused", {"GUBER_PALLAS_FUSED": "1",
+                                "GUBER_PROBE_SHARDS": "8"}))
         ADOPT_ENV = {"int64": ("GUBER_COMPACT32_XLA", "0"),
-                     "fused": ("GUBER_PALLAS_FUSED", "1")}
-        ms, exact = {}, {}
+                     "fused": ("GUBER_PALLAS_FUSED", "1"),
+                     "mesh_fused": ("GUBER_PALLAS_FUSED", "1")}
+        ms, exact, census = {}, {}, {}
         try:
             for name, extra in ARMS:
                 try:
-                    ms[name], exact[name] = run_arm(extra)
+                    ms[name], exact[name], cw = run_arm(extra)
+                    if cw is not None:
+                        census[name] = cw
                 except Exception as e:  # noqa: BLE001 — arm drops out
                     if name == "c32xla":
                         raise  # no baseline -> no decision at all
@@ -916,6 +932,8 @@ def child_main():
                         f"{type(e).__name__}: {str(e)[:160]}")
             result["pallas_ab_ms"] = {k: round(v, 2)
                                       for k, v in ms.items()}
+            if census:
+                result["pallas_ab_census"] = census  # kernels per window
             xla_ms = ms["c32xla"]
             best_ms, best = min((v, k) for k, v in ms.items()
                                 if exact.get(k))
@@ -944,9 +962,10 @@ def child_main():
                 # (the kill-nudge attempts double as wedge recovery if
                 # the probe left the tunnel in a bad state)
                 acquire_backend(init=False)
-                # shared pre-init probe deadline: stack-depth + the two
-                # pallas A/B subprocesses together may not eat the tiers'
-                # wall budget (pick_stack_depth keeps its own 240s cap)
+                # shared pre-init probe deadline: stack-depth + the
+                # pallas A/B arm subprocesses together may not eat the
+                # tiers' wall budget (pick_stack_depth keeps its own
+                # 240s cap)
                 probe_deadline = time.perf_counter() + 420.0
                 pick_stack_depth(result)
                 pick_pallas(result, probe_deadline)

@@ -166,6 +166,39 @@ def env_int(name: str, default: int, minimum: int = 1) -> int:
         return default
 
 
+_TRUTHY = frozenset(("1", "true", "yes", "on"))
+_FALSY = frozenset(("0", "false", "no", "off", ""))
+_warned_env: set = set()
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    """Boolean GUBER_* knob: accepts 0/1/true/false/yes/no/on/off
+    (case-insensitive); unset means `default`.  An unrecognized value
+    warns once per (name, value) and falls back to the default — the old
+    `== "1"` readers silently disabled features on `GUBER_PALLAS_FUSED=true`,
+    which is exactly the misconfiguration a perf flag must surface.
+
+    One shared reader for every on/off flag (engine executables,
+    pallas_kernel, probes): these flags are compiled-builder cache keys
+    read at build time, so every reader normalizing identically is part
+    of the executable-consistency contract."""
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    s = v.strip().lower()
+    if s in _TRUTHY:
+        return True
+    if s in _FALSY:
+        return False
+    if (name, v) not in _warned_env:
+        _warned_env.add((name, v))
+        import logging
+        logging.getLogger("gubernator.config").warning(
+            "unrecognized boolean value %r for %s (expected 0/1/true/false); "
+            "using default %s", v, name, default)
+    return default
+
+
 def load_env_file(path: str) -> None:
     """Load a KEY=value file into the process env (reference
     cmd/gubernator/config.go:239-267): '#' comments, blank lines skipped,

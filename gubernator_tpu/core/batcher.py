@@ -64,13 +64,15 @@ class WindowBatcher:
         self.stop_at_tick: Optional[int] = None
         # The pipelined serving lane (core/pipeline.py): compact-eligible
         # non-GLOBAL traffic coalesces into stacked compact dispatches;
-        # everything else (GLOBAL, out-of-range configs, no native router)
-        # stays on the legacy lanes below.  In lockstep (mesh) mode the
-        # SAME lane runs in lockstep form: staging is continuous, the
-        # drain dispatches as slot 1 of every cluster tick (fixed shape),
-        # and the legacy stacked step is slot 2 — so mesh serving gets the
-        # compact wire + duplicate-run fold without executable divergence
-        # across processes.
+        # everything else (out-of-range configs, no native router) stays
+        # on the legacy lanes below.  In lockstep (mesh) mode the SAME
+        # lane runs in lockstep form: staging is continuous, the drain
+        # dispatches as slot 1 of every cluster tick (fixed shape, the
+        # GLOBAL-composed fused executable — GLOBAL accumulate singles
+        # ride ITS composed psum window via eligible_global), and the
+        # legacy stacked step is slot 2 — so mesh serving gets the
+        # compact wire + duplicate-run fold + fused megakernel without
+        # executable divergence across processes.
         if engine.multiprocess and lockstep_clock is None:
             # fail loudly at construction: without a tick loop nothing
             # would ever drain a multiprocess engine's windows, and
@@ -289,7 +291,8 @@ class WindowBatcher:
             raise RuntimeError("lockstep dispatch failed; "
                                "this host left the mesh")
         if (self.pipeline is not None and accumulate
-                and self.pipeline.eligible(req)):
+                and (self.pipeline.eligible(req)
+                     or self.pipeline.eligible_global(req))):
             return await self.pipeline.submit_one(req)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending.append((req, accumulate, fut))

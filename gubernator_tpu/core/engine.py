@@ -48,7 +48,8 @@ from gubernator_tpu.ops.kernel import (
     WindowBatch,
     WindowOutput,
 )
-from gubernator_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+from gubernator_tpu.parallel.mesh import (SHARD_AXIS, make_mesh, shard_spec,
+                                          stacked_spec)
 from gubernator_tpu.state.arena import SlotTable
 
 
@@ -973,6 +974,28 @@ class RateLimitEngine:
                np.zeros((Kg,), np.int32))
         return gbatch, gacc, upd, ups
 
+    def empty_drain_control(self):
+        """(gbatch, gacc, upd) padding for a pipeline drain that carries no
+        GLOBAL lanes — LOCAL block shapes ([S_local, Bg]), unlike
+        empty_control's global ones, because the drain stages per-process
+        blocks (pipeline_dispatch_global reshards them).  Lanes point one
+        past the arena and are dropped."""
+        SL, Bg, G, Kg = (self.num_local_shards, self.global_batch_per_shard,
+                         self.global_capacity, self.max_global_updates)
+        gbatch = WindowBatch(
+            slot=np.full((SL, Bg), kernel.PAD_SLOT, np.int32),
+            hits=np.zeros((SL, Bg), np.int64),
+            limit=np.zeros((SL, Bg), np.int64),
+            duration=np.zeros((SL, Bg), np.int64),
+            algo=np.zeros((SL, Bg), np.int32),
+            is_init=np.zeros((SL, Bg), bool),
+        )
+        gacc = np.zeros((SL, Bg), np.int64)
+        upd = (np.full((Kg,), G, np.int32), np.zeros((Kg,), np.int64),
+               np.zeros((Kg,), np.int64), np.zeros((Kg,), np.int32),
+               np.full((Kg,), G, np.int32))
+        return gbatch, gacc, upd
+
     def register_global_keys(self, specs: Sequence[tuple],
                              now: Optional[int] = None,
                              pending: bool = False) -> None:
@@ -1084,15 +1107,31 @@ class RateLimitEngine:
                 _, _, mism = self.pipeline_dispatch(
                     packed, np.full(kb, now, np.int64), n_windows=0)
             jax.device_get(mism)
+            if k_stack is not None:
+                # lockstep serving (single-process mesh behind a tick
+                # clock): the tick's drain is the GLOBAL-composed variant
+                # at the tick's fixed shape
+                kb = max(k_stack, 1)
+                packed = np.zeros(
+                    (kb, self.num_shards, self.batch_per_shard, 2), np.int64)
+                gbatch, gacc, upd = self.empty_drain_control()
+                _, _, _, gfused = self.pipeline_dispatch_global(
+                    packed, np.full(kb, now, np.int64), gbatch, gacc, upd,
+                    n_windows=0)
+                jax.device_get(gfused)
         elif self.native is not None and self.multiprocess:
             # mesh lockstep drain: ONE fixed shape (the tick's k_stack),
-            # dispatched collectively — every process warms it together
+            # dispatched collectively — every process warms it together.
+            # The tick drain is the GLOBAL-composed variant (one psum per
+            # drain, core/pipeline.py lockstep mode).
             kb = max(k_stack or 1, 1)
             packed = np.zeros(
                 (kb, self.num_local_shards, self.batch_per_shard, 2),
                 np.int64)
-            _, _, mism = self.pipeline_dispatch(
-                packed, np.full(kb, now, np.int64), n_windows=0)
+            gbatch, gacc, upd = self.empty_drain_control()
+            _, _, mism, _ = self.pipeline_dispatch_global(
+                packed, np.full(kb, now, np.int64), gbatch, gacc, upd,
+                n_windows=0)
             self._fetch_local_stacked(mism)
 
     def _resolve_now(self, now: Optional[int]) -> int:
@@ -1150,9 +1189,10 @@ class RateLimitEngine:
         """Local [K, S_local, ...] stacked staging -> global [K, S, ...]."""
         if not self.multiprocess:
             return local_np
+        from gubernator_tpu.parallel.distributed import stacked_sharding
         gshape = ((local_np.shape[0], self.num_shards) + local_np.shape[2:])
         return jax.make_array_from_process_local_data(
-            NamedSharding(self.mesh, P(None, SHARD_AXIS)), local_np, gshape)
+            stacked_sharding(self.mesh), local_np, gshape)
 
     def _repl_in(self, arr):
         """Replicated input: every process MUST pass identical values."""
@@ -1318,6 +1358,45 @@ class RateLimitEngine:
                                    else n_windows)
         return words, limits, mism
 
+    def pipeline_dispatch_global(self, packed, nows, gbatch, gacc, upd,
+                                 n_windows: Optional[int] = None):
+        """The mesh serving drain: pipeline_dispatch's K-window compact
+        stack PLUS one GLOBAL window (replica reads + the reconciliation
+        psum + config writes), all in ONE device call with ONE collective
+        (_compiled_pipeline_step_global).  This is the lockstep tick's
+        drain executable — GLOBAL traffic no longer needs the legacy step
+        path to reach the mesh.
+
+        packed/nows: as pipeline_dispatch.  gbatch: full-format GLOBAL
+        WindowBatch [S_local, Bg] (PAD_SLOT lanes drop); gacc: the psum
+        hit contributions i64[S_local, Bg]; upd: the 5-tuple of replicated
+        config-update/reset lanes (engine.empty_drain_control provides
+        inert padding for all three).  Returns un-fetched (words, limits,
+        mism, gfused) — gfused i64[S, Bg, 4] is the GLOBAL response block
+        (status/limit/remaining/reset_time; fetch local rows with
+        _fetch_local).
+
+        Mesh mode: same lockstep contract as pipeline_dispatch — every
+        process dispatches this at the same sequence position with the
+        same K and identical nows/upd, every tick, staged lanes or not."""
+        if self.multiprocess:
+            packed = self._sharded_in_stacked(np.ascontiguousarray(packed))
+            nows = self._repl_in(np.asarray(nows, np.int64))
+            gbatch = WindowBatch(*[self._sharded_in(np.asarray(a))
+                                   for a in gbatch])
+            gacc = self._sharded_in(np.asarray(gacc))
+            upd = tuple(self._repl_in(a) for a in upd)
+        fn = _compiled_pipeline_step_global(self.mesh)
+        with jax.profiler.StepTraceAnnotation(
+                "guber_drain", step_num=self.windows_processed):
+            (self.state, words, limits, mism, gfused,
+             self.gstate, self.gcfg) = fn(
+                self.state, self.gstate, self.gcfg, packed, gbatch, gacc,
+                upd, nows)
+        self.windows_processed += (int(packed.shape[0]) if n_windows is None
+                                   else n_windows)
+        return words, limits, mism, gfused
+
     def process(
         self,
         requests: Sequence[RateLimitReq],
@@ -1439,8 +1518,8 @@ def _use_pallas() -> bool:
     """Opt-in Pallas lowering (GUBER_PALLAS=1) for the window kernel and
     the GLOBAL apply pass (ops/pallas_kernel.py).  Read at trace time —
     i.e. once per mesh, when each executable family builds."""
-    import os
-    return os.environ.get("GUBER_PALLAS") == "1"
+    from gubernator_tpu.config import env_bool
+    return env_bool("GUBER_PALLAS", False)
 
 
 def _use_compact32_xla() -> bool:
@@ -1448,8 +1527,8 @@ def _use_compact32_xla() -> bool:
     (GUBER_COMPACT32_XLA=0 reverts to the int64 kernel).  Same read-at-
     build-time discipline as _use_pallas: the flag is part of each
     compiled builder's cache key, never read mid-trace."""
-    import os
-    return os.environ.get("GUBER_COMPACT32_XLA", "1") == "1"
+    from gubernator_tpu.config import env_bool
+    return env_bool("GUBER_COMPACT32_XLA", True)
 
 
 def _use_pallas_fused() -> bool:
@@ -1461,8 +1540,8 @@ def _use_pallas_fused() -> bool:
     parity-gated A/B.  Same read-at-build-time discipline as _use_pallas.
     Takes precedence over GUBER_PALLAS at compact call sites; full-format
     call sites are unaffected (their lanes may exceed the rebase range)."""
-    import os
-    return os.environ.get("GUBER_PALLAS_FUSED") == "1"
+    from gubernator_tpu.ops.pallas_kernel import fused_enabled
+    return fused_enabled(False)
 
 
 def _recursion_guarded(fn):
@@ -1556,6 +1635,17 @@ def _apply_control(gstate: BucketState, gcfg: GlobalConfig, upd, ups):
         duration=gcfg.duration.at[pslot].set(pduration, mode="drop"),
         algo=gcfg.algo.at[pslot].set(palgo, mode="drop"),
     )
+    return _apply_config(gstate, gcfg, upd)
+
+
+def _apply_config(gstate: BucketState, gcfg: GlobalConfig, upd):
+    """The host-issued slot-(re)configuration half of _apply_control: the
+    config write refreshes limit/duration/algorithm from the latest request
+    each window; the state reset (expire=0 reads as never-initialized)
+    happens only for lanes the host just (re)allocated.  The pipeline
+    drain's GLOBAL window applies ONLY this half — drains never carry
+    upserts (mesh mode forbids them outright, and the single-process
+    batcher routes them through step())."""
     uslot, ulimit, uduration, ualgo, rslot = upd
     gcfg = GlobalConfig(
         limit=gcfg.limit.at[uslot].set(ulimit, mode="drop"),
@@ -1797,45 +1887,8 @@ def _compiled_pipeline_step_impl(mesh: Mesh, pallas: bool,
     def shard_fn(state, packed, nows):
         # Block shapes: state [1, C]; packed [K, 1, B, 2]; nows [K].
         st = BucketState(*jax.tree.map(lambda a: a[0], state))
-        # Fused megakernel needs a power-of-two lane count for its in-kernel
-        # bitonic sort; other widths fall back to compact32-XLA (B static).
-        B = packed.shape[-2]
-        use_fused = fused and (B & (B - 1)) == 0
-
-        def body(st, xs):
-            pk, now = xs
-            bt = kernel.decode_batch(pk[0])
-            st, out = _window_step_fn(mesh, compact32=True, pallas=pallas,
-                                      c32xla=c32xla)(st, bt, now)
-            word = kernel.encode_output_word(out, now)
-            mism = jnp.any((out.limit != bt.limit) & (bt.slot >= 0))
-            return st, (word, out.limit, mism)
-
-        if use_fused:
-            # decode, sort, prep, transitions, commit AND the word encode
-            # all happen inside ONE pallas_call per window — O(1) executed
-            # kernels instead of the XLA drain's per-op launches.  The
-            # arena converts to its i32 plane form ONCE per drain and the
-            # scan carries that form, so the O(C) conversion amortizes
-            # over all K windows.
-            from gubernator_tpu.ops.pallas_kernel import (
-                fused_state_from_planes,
-                fused_state_to_planes,
-                window_step_fused_planes,
-            )
-            on_cpu = _mesh_on_cpu(mesh)
-
-            def body32(st32, xs):
-                pk, now = xs
-                st32, word, limit, mism = window_step_fused_planes(
-                    st32, pk[0], now, interpret=on_cpu)
-                return st32, (word, limit, mism)
-
-            st32, (words, limits, mism) = lax.scan(
-                body32, fused_state_to_planes(st), (packed, nows))
-            st = fused_state_from_planes(st32)
-        else:
-            st, (words, limits, mism) = lax.scan(body, st, (packed, nows))
+        st, words, limits, mism = _drain_scan(mesh, pallas, c32xla, fused,
+                                              st, packed, nows)
         expand = lambda a: a[None]
         return (
             BucketState(*jax.tree.map(expand, st)),
@@ -1845,7 +1898,7 @@ def _compiled_pipeline_step_impl(mesh: Mesh, pallas: bool,
         )
 
     state_sharded = BucketState(*[P(SHARD_AXIS)] * 6)
-    stackedP = P(None, SHARD_AXIS)
+    stackedP = stacked_spec()
     sharded = _compat_shard_map(
         shard_fn,
         mesh=mesh,
@@ -1857,6 +1910,145 @@ def _compiled_pipeline_step_impl(mesh: Mesh, pallas: bool,
         out_specs=(state_sharded, stackedP, stackedP, stackedP),
     )
     fn = jax.jit(sharded, donate_argnums=(0,))
+    return _recursion_guarded(fn) if (pallas or fused) else fn
+
+
+def _drain_scan(mesh: Mesh, pallas: bool, c32xla: bool, fused: bool,
+                st: BucketState, packed, nows):
+    """The drain's regular-key K-scan (shared by the regular and the
+    GLOBAL-composed drain executables): K compact windows applied
+    sequentially to one shard's block, each window's decode→transition→
+    word-encode either fused into ONE pallas_call or lowered per-op by
+    compact32-XLA.  Returns (state, words[K,B], limits[K,B], mism[K])."""
+    # Fused megakernel needs a power-of-two lane count for its in-kernel
+    # bitonic sort; other widths fall back to compact32-XLA (B static).
+    B = packed.shape[-2]
+    use_fused = fused and (B & (B - 1)) == 0
+
+    def body(st, xs):
+        pk, now = xs
+        bt = kernel.decode_batch(pk[0])
+        st, out = _window_step_fn(mesh, compact32=True, pallas=pallas,
+                                  c32xla=c32xla)(st, bt, now)
+        word = kernel.encode_output_word(out, now)
+        mism = jnp.any((out.limit != bt.limit) & (bt.slot >= 0))
+        return st, (word, out.limit, mism)
+
+    if use_fused:
+        # decode, sort, prep, transitions, commit AND the word encode
+        # all happen inside ONE pallas_call per window — O(1) executed
+        # kernels instead of the XLA drain's per-op launches.  The
+        # arena converts to its i32 plane form ONCE per drain and the
+        # scan carries that form, so the O(C) conversion amortizes
+        # over all K windows.
+        from gubernator_tpu.ops.pallas_kernel import (
+            fused_state_from_planes,
+            fused_state_to_planes,
+            window_step_fused_planes,
+        )
+        on_cpu = _mesh_on_cpu(mesh)
+
+        def body32(st32, xs):
+            pk, now = xs
+            st32, word, limit, mism = window_step_fused_planes(
+                st32, pk[0], now, interpret=on_cpu)
+            return st32, (word, limit, mism)
+
+        st32, (words, limits, mism) = lax.scan(
+            body32, fused_state_to_planes(st), (packed, nows))
+        st = fused_state_from_planes(st32)
+    else:
+        st, (words, limits, mism) = lax.scan(body, st, (packed, nows))
+    return st, words, limits, mism
+
+
+def _compiled_pipeline_step_global(mesh: Mesh):
+    return _compiled_pipeline_step_global_impl(mesh, _use_pallas(),
+                                               _use_compact32_xla(),
+                                               _use_pallas_fused())
+
+
+@lru_cache(maxsize=None)
+def _compiled_pipeline_step_global_impl(mesh: Mesh, pallas: bool,
+                                        c32xla: bool, fused: bool = False):
+    """The mesh serving drain: _compiled_pipeline_step's K-scan PLUS one
+    GLOBAL reconciliation window composed around it — the lockstep tick's
+    single executable.
+
+    Every chip runs the fused (or compact32-XLA) kernel per window over
+    its own plane-arena shard, and the whole drain pays exactly ONE
+    collective: the GLOBAL hit-delta psum of `_global_window`, applied
+    once at the drain's timestamp (nows[0]; the lockstep tick stages all
+    K windows at the tick time, so there is nothing later to order
+    against).  This replaces the legacy mesh path's per-stage kernels and
+    per-window psum — the drain's cost model becomes
+    (K pallas_calls + one GLOBAL window) / K windows, against the legacy
+    step's ~hundreds of launches per window.
+
+    GLOBAL lanes keep the FULL wire format (they are few — Bg per shard —
+    and exempt from the compact saturation rules); the control plane is
+    the upd 5-tuple only (config refresh + reallocation resets): drains
+    never carry upserts.  Donation covers the sharded arena and the
+    replicated GLOBAL arena/config, so planes are carried, not copied,
+    across ticks."""
+    def shard_fn(state, gstate, gcfg, packed, gbatch, gacc, upd, nows):
+        # Block shapes: state [1, C]; packed [K, 1, B, 2]; gbatch/gacc
+        # [1, Bg]; gstate/gcfg [G] (replicated); upd [Kg] (replicated);
+        # nows [K].
+        st = BucketState(*jax.tree.map(lambda a: a[0], state))
+        st, words, limits, mism = _drain_scan(mesh, pallas, c32xla, fused,
+                                              st, packed, nows)
+
+        gstate, gcfg = _apply_config(gstate, gcfg, upd)
+        gb = WindowBatch(*jax.tree.map(lambda a: a[0], gbatch))
+        new_g, gout = _global_window(gstate, gcfg, gb, gacc[0], nows[0],
+                                     mesh, pallas)
+        gfused = jnp.stack(
+            [gout.status.astype(jnp.int64), gout.limit, gout.remaining,
+             gout.reset_time], axis=-1)
+
+        expand = lambda a: a[None]
+        return (
+            BucketState(*jax.tree.map(expand, st)),
+            words[:, None],
+            limits[:, None],
+            mism[:, None],
+            gfused[None],
+            new_g,
+            gcfg,
+        )
+
+    state_sharded = BucketState(*[P(SHARD_AXIS)] * 6)
+    state_repl = BucketState(*[P()] * 6)
+    stackedP = stacked_spec()
+    sharded = _compat_shard_map(
+        shard_fn,
+        mesh=mesh,
+        # the Pallas window kernel cannot carry vma tags through its
+        # interpret-mode while_loop (jnp.take drops them); vma checking is
+        # an XLA-path-only invariant here
+        check_vma=not (pallas or fused),
+        in_specs=(
+            state_sharded,
+            state_repl,
+            GlobalConfig(*[P()] * 3),
+            stackedP,
+            WindowBatch(*[shard_spec()] * 6),
+            shard_spec(),
+            (P(), P(), P(), P(), P()),
+            P(),
+        ),
+        out_specs=(
+            state_sharded,
+            stackedP,
+            stackedP,
+            stackedP,
+            shard_spec(),
+            state_repl,
+            GlobalConfig(*[P()] * 3),
+        ),
+    )
+    fn = jax.jit(sharded, donate_argnums=(0, 1, 2))
     return _recursion_guarded(fn) if (pallas or fused) else fn
 
 

@@ -32,9 +32,16 @@ without waiting for each response (peers.go:143-172); the reference's
 500µs/1000-item aggregation window (config.go:60-62) corresponds to the
 natural accumulation that happens while the pipeline is at depth.
 
-GLOBAL-behavior traffic, out-of-range configs, and mesh (lockstep) serving
-stay on the legacy step path — the pipeline and that path serialize on the
-same single-thread engine executor, so state mutation order is well defined.
+Mesh (lockstep) serving runs the SAME drain: the tick's drain executable is
+the GLOBAL-composed variant (engine.pipeline_dispatch_global) — every chip
+runs the fused kernel per window over its own plane-arena shard, with ONE
+GLOBAL reconciliation psum composed around the K-scan per drain — so mesh
+mode gets the same one-dispatch-per-drain, overlapped-fetch structure as a
+single chip, and GLOBAL singles ride the drain's composed window
+(_GlobalJob) instead of the legacy step.  Only out-of-range configs and
+GLOBAL traffic outside lockstep mode stay on the legacy step path — the
+pipeline and that path serialize on the same single-thread engine
+executor, so state mutation order is well defined.
 """
 
 from __future__ import annotations
@@ -265,21 +272,55 @@ class ListJob:
         ]
 
 
+class _GlobalJob:
+    """GLOBAL singles riding the lockstep drain's composed psum window
+    (full wire format — GLOBAL lanes are exempt from the compact range
+    caps).  Staged round-robin over local shards by _drain_sync, resolved
+    per-request like a ListJob with futs; decodes the drain's gfused
+    response block ([S_local, Bg, 4] = status/limit/remaining/reset_time)
+    directly."""
+
+    __slots__ = ("reqs", "futs", "fut", "n", "shard", "lane")
+
+    def __init__(self, reqs: Sequence[RateLimitReq],
+                 futs: List[asyncio.Future]):
+        self.reqs = list(reqs)
+        self.futs = futs
+        self.fut = None
+        self.n = len(self.reqs)
+        self.shard = np.empty(self.n, np.int32)
+        self.lane = np.empty(self.n, np.int32)
+
+    def finish_global(self, gflat) -> List[RateLimitResp]:
+        s, ln = self.shard, self.lane
+        status = gflat[s, ln, 0].tolist()
+        limit = gflat[s, ln, 1].tolist()
+        remaining = gflat[s, ln, 2].tolist()
+        reset = gflat[s, ln, 3].tolist()
+        return [
+            RateLimitResp(status=status[i], limit=limit[i],
+                          remaining=remaining[i], reset_time=reset[i])
+            for i in range(self.n)
+        ]
+
+
 class _DrainResult:
-    __slots__ = ("words", "limits", "mism", "staged", "fallback", "leftover",
-                 "now", "n_decisions", "n_lanes", "error", "started",
-                 "ring_peers")
+    __slots__ = ("words", "limits", "mism", "gfused", "staged", "fallback",
+                 "leftover", "now", "n_decisions", "n_lanes", "k_used",
+                 "error", "started", "ring_peers")
 
     def __init__(self):
         self.words = None
         self.limits = None
         self.mism = None
+        self.gfused = None
         self.staged = []
         self.fallback = []
         self.leftover = []
         self.now = 0
         self.n_decisions = 0
         self.n_lanes = 0
+        self.k_used = 0
         self.error = None
         self.started = 0.0
         self.ring_peers = ()
@@ -352,7 +393,16 @@ class DispatchPipeline:
             max_workers=env_int("GUBER_FETCH_WORKERS", 2),
             thread_name_prefix="guber-fetch")
         self._singles: List[tuple] = []   # (req, fut)
+        # GLOBAL singles (lockstep mode only): staged into the tick drain's
+        # composed GLOBAL window, never mixed into regular ListJobs
+        self._gsingles: List[tuple] = []  # (req, fut)
         self._jobs: List[object] = []     # FIFO of RpcJob/ListJob
+        # fused-path adoption (observability): does this engine's drain
+        # lower to the fused megakernel?  Read once — same build-time
+        # discipline as the engine's compiled-builder cache keys.
+        from gubernator_tpu.ops.pallas_kernel import fused_enabled
+        B = engine.batch_per_shard
+        self.fused_serving = fused_enabled(False) and (B & (B - 1)) == 0
         self._in_flight = 0
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         # observability: RPCs fully served by this lane (tests assert the
@@ -412,7 +462,14 @@ class DispatchPipeline:
     async def submit_one(self, req: RateLimitReq) -> RateLimitResp:
         self._loop = asyncio.get_running_loop()
         fut = self._loop.create_future()
-        self._singles.append((req, fut))
+        if req.behavior == Behavior.GLOBAL:
+            # only reachable through eligible_global (lockstep mode):
+            # GLOBAL singles keep their own queue so regular ListJobs
+            # never mix behaviors (the C router shard-routes by key hash;
+            # GLOBAL lanes spread round-robin instead)
+            self._gsingles.append((req, fut))
+        else:
+            self._singles.append((req, fut))
         self._pump()
         return await fut
 
@@ -446,6 +503,24 @@ class DispatchPipeline:
             return (self.engine._compact_sound
                     and self.engine.routing_error(req) is None)
         return self.engine._compact_enabled
+
+    def eligible_global(self, req: RateLimitReq) -> bool:
+        """May this GLOBAL request ride the lockstep drain's composed
+        GLOBAL window?  Lockstep mode only: there the tick's drain
+        executable (engine.pipeline_dispatch_global) carries full-format
+        GLOBAL lanes and one reconciliation psum per drain, so GLOBAL
+        singles no longer need the legacy step.  No compact range checks —
+        GLOBAL lanes are exempt (full wire format).  Outside lockstep mode
+        GLOBAL traffic keeps the legacy path (the non-lockstep drain
+        dispatches the collective-free regular executable)."""
+        if not (self.enabled
+                and self.lockstep
+                and not self._closed
+                and req.behavior == Behavior.GLOBAL
+                and req.algorithm in (Algorithm.TOKEN_BUCKET,
+                                      Algorithm.LEAKY_BUCKET)):
+            return False
+        return self.engine.routing_error(req) is None
 
     # ------------------------------------------------------------ pump
 
@@ -492,6 +567,36 @@ class DispatchPipeline:
         self._coalesce_handle = None
         self._pump(force=True)
 
+    def _take_global_job(self) -> Optional[_GlobalJob]:
+        """Snapshot the queued GLOBAL singles into one _GlobalJob for this
+        tick's drain (loop thread).  Invalid requests (unregistered GLOBAL
+        key in non-dynamic mesh mode) fail individually here — mirroring
+        the batcher's _take_window — so staging can never raise for them
+        on the engine thread.  Overflow beyond the drain's GLOBAL lane cap
+        rides the NEXT tick (pushed back to the queue front)."""
+        if not self._gsingles:
+            return None
+        eng = self.engine
+        cap = eng.num_local_shards * eng.global_batch_per_shard
+        if eng._dynamic_global:
+            # dynamic mode stages a config-update lane per distinct key;
+            # bounding n by max_global_updates bounds distinct slots too
+            cap = min(cap, eng.max_global_updates)
+        items, self._gsingles = self._gsingles, []
+        ok: List[tuple] = []
+        for r, f in items:
+            if len(ok) >= cap:
+                self._gsingles.append((r, f))
+                continue
+            err = eng.routing_error(r)
+            if err is None:
+                ok.append((r, f))
+            elif not f.done():
+                f.set_exception(ValueError(err))
+        if not ok:
+            return None
+        return _GlobalJob([r for r, _ in ok], [f for _, f in ok])
+
     def lockstep_pump(self, now: int, k_stack: int):
         """Issue this tick's drain (mesh mode, event loop).  The dispatch
         ALWAYS happens — the drain executable is slot 1 of the tick's
@@ -505,11 +610,14 @@ class DispatchPipeline:
         if self._loop is None:
             self._loop = asyncio.get_running_loop()
         jobs = self._take_jobs() if not self._closed else []
+        gjob = self._take_global_job() if not self._closed else None
+        all_jobs = jobs + ([gjob] if gjob is not None else [])
         self._in_flight += 1
         fut = self._loop.run_in_executor(
             self._engine_executor,
-            lambda: self._drain_sync(jobs, now=now, k_fixed=k_stack))
-        fut.add_done_callback(lambda f: self._on_dispatched(f, jobs))
+            lambda: self._drain_sync(jobs, now=now, k_fixed=k_stack,
+                                     gjob=gjob))
+        fut.add_done_callback(lambda f: self._on_dispatched(f, all_jobs))
         return fut
 
     def _on_dispatched(self, fut, jobs) -> None:
@@ -655,6 +763,12 @@ class DispatchPipeline:
                 time.monotonic() - res.started)
             self.metrics.agg_decisions.inc(res.n_decisions)
             self.metrics.agg_lanes.inc(res.n_lanes)
+            # fused-path adoption + per-drain window depth (ISSUE 2
+            # observability): how deep the stacks actually run, and whether
+            # the drains lower to the fused megakernel
+            self.metrics.drain_depth.observe(res.k_used)
+            if self.fused_serving:
+                self.metrics.fused_drains.inc()
         self._pump(force=True)
 
     async def _assemble_mixed(self, job: RpcJob, local_parts, now) -> None:
@@ -721,7 +835,8 @@ class DispatchPipeline:
     # ------------------------------------------------------------ engine side
 
     def _drain_sync(self, jobs: List[object], now: Optional[int] = None,
-                    k_fixed: Optional[int] = None) -> _DrainResult:
+                    k_fixed: Optional[int] = None,
+                    gjob: Optional[_GlobalJob] = None) -> _DrainResult:
         """Pack every job into one stacked compact dispatch (engine thread).
 
         Fresh numpy staging per drain: the previous drain's arrays may still
@@ -730,7 +845,10 @@ class DispatchPipeline:
         Lockstep mode (k_fixed set): `now` is the tick's cluster-agreed
         timestamp and the dispatch shape is ALWAYS [k_fixed] — issued even
         with nothing staged, because the drain is part of the tick's
-        collective sequence on every process."""
+        collective sequence on every process.  The tick drain is the
+        GLOBAL-composed executable (engine.pipeline_dispatch_global): the
+        fused K-scan plus ONE reconciliation psum per drain, with `gjob`'s
+        GLOBAL singles staged round-robin into its full-format lanes."""
         eng = self.engine
         native = eng.native
         S = eng.num_local_shards
@@ -796,22 +914,71 @@ class DispatchPipeline:
                 else:
                     res.fallback.append(job)
 
-        if not res.staged and not self.lockstep:
+        if not res.staged and gjob is None and not self.lockstep:
             return res
         k_used = int(fills.any(axis=1).sum())
+        res.k_used = k_used
         if self.lockstep:
+            # Stage the tick's GLOBAL singles into the drain's composed
+            # window (full wire format, round-robin over local shards —
+            # the psum is shard-agnostic, mirroring _stage_requests).
+            gbatch, gacc, upd = eng.empty_drain_control()
+            SL = eng.num_local_shards
+            if gjob is not None:
+                eng.gtable.begin_window()
+                try:
+                    gcfg_upd: dict = {}
+                    greset: List[int] = []
+                    gfill = np.zeros(SL, np.int32)
+                    for i, r in enumerate(gjob.reqs):
+                        slot, is_init = eng.gtable.lookup(
+                            r.hash_key(), now, r.duration)
+                        if eng._dynamic_global:
+                            gcfg_upd[slot] = (r.limit, r.duration,
+                                              r.algorithm)
+                            if is_init:
+                                greset.append(slot)
+                        s = i % SL
+                        lane = int(gfill[s])
+                        gfill[s] += 1
+                        gjob.shard[i] = s
+                        gjob.lane[i] = lane
+                        gbatch.slot[s, lane] = slot
+                        gbatch.hits[s, lane] = r.hits
+                        gbatch.limit[s, lane] = r.limit
+                        gbatch.duration[s, lane] = r.duration
+                        gbatch.algo[s, lane] = r.algorithm
+                        gbatch.is_init[s, lane] = is_init
+                        gacc[s, lane] = r.hits
+                    for j, (slot, cfg) in enumerate(gcfg_upd.items()):
+                        upd[0][j] = slot
+                        upd[1][j], upd[2][j], upd[3][j] = cfg
+                    for j, slot in enumerate(greset):
+                        upd[4][j] = slot
+                    res.staged.append(gjob)
+                except Exception:
+                    # staging failed (arena full, ...): the fresh
+                    # allocations stay pending (no commit) and the job
+                    # re-routes through the legacy lane; the drain still
+                    # dispatches with inert GLOBAL padding
+                    res.fallback.append(gjob)
+                    gjob = None
+                    gbatch, gacc, upd = eng.empty_drain_control()
             # the tick's drain dispatch is unconditional and fixed-shape:
             # every process issues it at the same sequence position
             before = eng.windows_processed
             dispatched = False
             try:
-                words, limits, mism = eng.pipeline_dispatch(
-                    packed, np.full(K, now, np.int64), n_windows=k_used)
+                words, limits, mism, gfused = eng.pipeline_dispatch_global(
+                    packed, np.full(K, now, np.int64), gbatch, gacc, upd,
+                    n_windows=k_used)
                 dispatched = True  # sentinel: windows_processed advances
                 # by k_used, which is 0 on an idle tick — the counter
                 # alone cannot distinguish 'dispatched 0 windows' from
                 # 'never dispatched' for the realign decision below
                 native.commit()
+                if gjob is not None:
+                    eng.gtable.commit_window()
             except Exception as e:
                 native.abort()
                 res.error = e  # _on_dispatched fails the staged jobs
@@ -823,11 +990,12 @@ class DispatchPipeline:
                 # instead of silently desyncing.
                 if not dispatched and eng.windows_processed == before:
                     zeros = np.zeros_like(packed)
+                    zb, za, zu = eng.empty_drain_control()
                     for attempt in range(3):
                         try:
-                            eng.pipeline_dispatch(
+                            eng.pipeline_dispatch_global(
                                 zeros, np.full(K, now, np.int64),
-                                n_windows=0)
+                                zb, za, zu, n_windows=0)
                             break
                         except Exception:
                             if attempt == 2:
@@ -838,9 +1006,13 @@ class DispatchPipeline:
                 try:
                     words.copy_to_host_async()
                     mism.copy_to_host_async()
+                    if gjob is not None:
+                        gfused.copy_to_host_async()
                 except Exception:
                     pass  # fetch path will block instead
                 res.words, res.limits, res.mism = words, limits, mism
+                if gjob is not None:
+                    res.gfused = gfused
         elif k_used:  # an all-forwarded drain has nothing to dispatch
             kb = next(b for b in self._k_buckets if b >= k_used)
             try:
@@ -897,7 +1069,13 @@ class DispatchPipeline:
                 clflat = np.ascontiguousarray(
                     eng._fetch_local_stacked(res.limits)).reshape(-1, B)
             wflat = words.reshape(-1, B)
-        outs = [job.finish(self, wflat, clflat, res.now)
+        gflat = None
+        if res.gfused is not None:
+            # this process's GLOBAL response rows [S_local, Bg, 4], indexed
+            # exactly as the round-robin staging wrote (shard, lane)
+            gflat = eng._fetch_local(res.gfused)
+        outs = [job.finish_global(gflat) if isinstance(job, _GlobalJob)
+                else job.finish(self, wflat, clflat, res.now)
                 for job in res.staged]
         return res, outs
 
@@ -913,9 +1091,13 @@ class DispatchPipeline:
         err = RuntimeError("pipeline closed")
         jobs, self._jobs = self._jobs, []
         singles, self._singles = self._singles, []
+        gsingles, self._gsingles = self._gsingles, []
         for job in jobs:
             self._resolve_error(job, err)
         for _, f in singles:
+            if not f.done():
+                f.set_exception(err)
+        for _, f in gsingles:
             if not f.done():
                 f.set_exception(err)
         self._fetch_executor.shutdown(wait=False)

@@ -94,6 +94,22 @@ class Metrics:
             "Wall time of one device window step.",
             registry=self.registry,
         )
+        # fused-path adoption + drain depth (core/pipeline.py): how many
+        # drains lowered to the fused megakernel, and how many windows deep
+        # each drain's K-stack actually ran — rate(fused)/rate(windows) is
+        # live adoption, the depth histogram is the decisions-per-dispatch
+        # lever the cost model optimizes
+        self.fused_drains = Counter(
+            "guber_tpu_fused_drains_total",
+            "Pipeline drains served by the fused Pallas megakernel.",
+            registry=self.registry,
+        )
+        self.drain_depth = Histogram(
+            "guber_tpu_drain_depth_windows",
+            "Occupied window depth K per pipeline drain.",
+            buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
+            registry=self.registry,
+        )
 
     def add_scrape_hook(self, fn) -> None:
         """Register a callable run before every expose() — the analog of the

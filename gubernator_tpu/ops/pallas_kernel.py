@@ -70,6 +70,44 @@ from gubernator_tpu.ops.kernel import (
 BLOCK = 1024
 
 
+def fused_enabled(default: bool = False) -> bool:
+    """Shared GUBER_PALLAS_FUSED reader (config.env_bool normalization:
+    0/1/true/false/yes/no/on/off, warn on anything else).  The engine's
+    compiled-builder cache keys, the bench probes, and tests must all
+    normalize this flag identically — a reader that only accepted the
+    literal "1" silently disabled the megakernel on `=true`."""
+    from gubernator_tpu.config import env_bool
+    return env_bool("GUBER_PALLAS_FUSED", default)
+
+
+def kernel_census(closed) -> int:
+    """Executed-kernel proxy over a ClosedJaxpr: count equations, recursing
+    into sub-jaxprs (scan/while/cond/pjit bodies count once — per-window
+    cost), with a pallas_call counting as ONE kernel regardless of its
+    body.  On real TPU each surviving top-level op is at least one kernel
+    launch (XLA fusion only merges elementwise neighbors; the gathers,
+    scatters, sort passes and the scan skeleton stay distinct), so census
+    ratios are a conservative stand-in for launch-count ratios.  Shared by
+    the fused-megakernel test suites and bench.py's per-arm census."""
+    def walk(jaxpr):
+        n = 0
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "pallas_call":
+                n += 1
+                continue
+            subs = []
+            for v in eqn.params.values():
+                vs = v if isinstance(v, (tuple, list)) else (v,)
+                for x in vs:
+                    if hasattr(x, "jaxpr"):
+                        subs.append(x.jaxpr)   # ClosedJaxpr
+                    elif hasattr(x, "eqns"):
+                        subs.append(x)         # Jaxpr
+            n += sum(walk(s) for s in subs) if subs else 1
+        return n
+    return walk(closed.jaxpr)
+
+
 @contextlib.contextmanager
 def mosaic_recursion_guard(limit: int = 20000):
     """Temporarily raise the recursion ceiling around a Mosaic lowering.

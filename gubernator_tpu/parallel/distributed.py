@@ -29,11 +29,13 @@ Env surface (daemon wiring):
 from __future__ import annotations
 
 import os
+from functools import lru_cache as _functools_lru_cache
 
 import jax
 import numpy as np
 
-from gubernator_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+from gubernator_tpu.parallel.mesh import (SHARD_AXIS, make_mesh, shard_spec,
+                                          stacked_spec)
 
 
 def initialize_from_env() -> bool:
@@ -55,6 +57,24 @@ def initialize_from_env() -> bool:
 def global_mesh():
     """The mesh over every device of every process (shard axis)."""
     return make_mesh(jax.devices())
+
+
+@_functools_lru_cache(maxsize=None)
+def shard_sharding(mesh):
+    """NamedSharding for [S, ...] per-shard arrays (cached per mesh).
+
+    Staging rebuilds the same placement for every dispatch; meshes are
+    long-lived and hashable, so cache the NamedSharding objects instead of
+    re-deriving them on the hot path."""
+    from jax.sharding import NamedSharding
+    return NamedSharding(mesh, shard_spec())
+
+
+@_functools_lru_cache(maxsize=None)
+def stacked_sharding(mesh):
+    """NamedSharding for [K, S, ...] drain stacks (cached per mesh)."""
+    from jax.sharding import NamedSharding
+    return NamedSharding(mesh, stacked_spec())
 
 
 def local_device_indices(mesh) -> list[int]:

@@ -13,7 +13,7 @@ from typing import Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec as P
 
 SHARD_AXIS = "shard"
 
@@ -23,3 +23,23 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     if devices is None:
         devices = jax.devices()
     return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+
+# Canonical PartitionSpecs for the engine's arrays (single source of truth
+# for the executables' shard_map specs — core/engine.py):
+#   shard_spec      [S, ...] per-shard blocks (bucket arena, window lanes)
+#   stacked_spec    [K, S, ...] pipeline-drain stacks (leading window axis
+#                   replicated, shard axis second — the plane arena's
+#                   stacked wire layout)
+#   replicated_spec GLOBAL arena / control-plane inputs (identical on
+#                   every shard; mutated only through the psum)
+def shard_spec() -> P:
+    return P(SHARD_AXIS)
+
+
+def stacked_spec() -> P:
+    return P(None, SHARD_AXIS)
+
+
+def replicated_spec() -> P:
+    return P()

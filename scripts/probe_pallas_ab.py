@@ -5,10 +5,20 @@ Run once per arm (fresh process each — executables cache per (mesh, flags)):
     GUBER_COMPACT32_XLA=0 python scripts/probe_pallas_ab.py  # int64 XLA
     GUBER_PALLAS=1 python scripts/probe_pallas_ab.py         # per-window Pallas
     GUBER_PALLAS_FUSED=1 python scripts/probe_pallas_ab.py   # fused megakernel
+    GUBER_PALLAS_FUSED=1 GUBER_PROBE_SHARDS=8 \
+        python scripts/probe_pallas_ab.py                    # mesh composed drain
+
+GUBER_PROBE_SHARDS > 1 probes the MESH serving path: the drain is the
+GLOBAL-composed executable (engine.pipeline_dispatch_global — shard_map
+over the shard axis, one reconciliation psum per drain), the same
+executable the lockstep tick dispatches.  Shard count clamps to the
+available devices.
 
 Measures the honest per-window cost by the K-stack slope (one dispatch,
 internal lax.scan, one final fetch; K=1 vs K=9), plus functional parity of
-the first window's response words against the no-Pallas kernel on host.
+the first window's response words against the no-Pallas kernel on host,
+plus the drain executable's jaxpr kernel census (bench.py records it
+per arm).
 
 If the per-HLO-op-overhead hypothesis (BENCH_NOTES.md) is right, the
 Pallas variant — whose window math is ONE op instead of hundreds — should
@@ -27,6 +37,7 @@ import jax
 from scripts._probe_env import setup as _setup
 _setup()
 
+from gubernator_tpu.config import env_bool, env_int
 from gubernator_tpu.core.engine import RateLimitEngine
 from gubernator_tpu.parallel.mesh import make_mesh
 
@@ -36,30 +47,44 @@ KHI = int(os.environ.get("GUBER_PROBE_KHI", "9"))
 REPS = int(os.environ.get("GUBER_PROBE_REPS", "8"))
 now0 = 1_700_000_000_000
 devs = jax.devices()
+SHARDS = max(1, min(env_int("GUBER_PROBE_SHARDS", 1), len(devs)))
 # Mode ladder mirrors the engine's dispatch precedence (fused > per-window
 # Pallas > compact32-XLA > int64-XLA); each arm needs a fresh process.
-if os.environ.get("GUBER_PALLAS_FUSED") == "1":
+# Flags parse through the shared normalized reader (config.env_bool) —
+# the same values the engine's compiled-builder cache keys will see.
+if env_bool("GUBER_PALLAS_FUSED"):
     mode = "pallas-fused"
-elif os.environ.get("GUBER_PALLAS") == "1":
+elif env_bool("GUBER_PALLAS"):
     mode = "pallas-compact32"
-elif os.environ.get("GUBER_COMPACT32_XLA", "1") == "1":
+elif env_bool("GUBER_COMPACT32_XLA", True):
     mode = "xla-compact32"
 else:
     mode = "xla-int64"
+if SHARDS > 1:
+    mode += f"-mesh{SHARDS}"
 print(f"# backend: {devs[0].platform}  mode: {mode}", file=sys.stderr,
       flush=True)
-mesh = make_mesh(devs[:1])
+mesh = make_mesh(devs[:SHARDS])
 rng = np.random.default_rng(5)
 
 
-def stacked_time(k):
-    eng = RateLimitEngine(mesh=mesh, capacity_per_shard=CAP,
-                          batch_per_shard=B, global_capacity=64,
-                          global_batch_per_shard=8, max_global_updates=8)
+def _mk_engine():
+    return RateLimitEngine(mesh=mesh, capacity_per_shard=CAP,
+                           batch_per_shard=B, global_capacity=64,
+                           global_batch_per_shard=8, max_global_updates=8)
+
+
+def _mk_stack(k):
     slots = ((rng.zipf(1.1, (k, B)) - 1) % CAP).astype(np.int64)
-    packed = np.zeros((k, 1, B, 2), np.int64)
-    packed[:, 0, :, 0] = (slots + 1) | (1 << 34)  # hits=1
-    packed[:, 0, :, 1] = np.int64(1_000_000) | (np.int64(600_000) << 32)
+    packed = np.zeros((k, SHARDS, B, 2), np.int64)
+    packed[:, :, :, 0] = ((slots + 1) | (1 << 34))[:, None, :]  # hits=1
+    packed[:, :, :, 1] = np.int64(1_000_000) | (np.int64(600_000) << 32)
+    return packed
+
+
+def stacked_time(k):
+    eng = _mk_engine()
+    packed = _mk_stack(k)
     nows = now0 + np.arange(k, dtype=np.int64)
     dpacked = jax.device_put(packed)
 
@@ -67,12 +92,40 @@ def stacked_time(k):
     ts = []
     for rep in range(REPS):
         t0 = time.perf_counter()
-        words, _, _ = eng.pipeline_dispatch(dpacked, nows + rep * k,
-                                            n_windows=k)
+        if SHARDS > 1:
+            # the mesh serving drain: composed GLOBAL window, one psum
+            gb, ga, upd = eng.empty_drain_control()
+            words, _, _, _ = eng.pipeline_dispatch_global(
+                dpacked, nows + rep * k, gb, ga, upd, n_windows=k)
+        else:
+            words, _, _ = eng.pipeline_dispatch(dpacked, nows + rep * k,
+                                                n_windows=k)
         host = np.asarray(words)
         ts.append(time.perf_counter() - t0)
     del eng
     return float(np.percentile(np.array(ts[1:]) * 1e3, 50)), host, packed
+
+
+def drain_census(k):
+    """Jaxpr kernel census of the drain executable this arm dispatches
+    (pallas_kernel.kernel_census: scan bodies count once — per-window
+    cost; a pallas_call counts as one kernel)."""
+    from gubernator_tpu.core.engine import (_compiled_pipeline_step,
+                                            _compiled_pipeline_step_global)
+    from gubernator_tpu.ops.pallas_kernel import kernel_census
+
+    eng = _mk_engine()
+    packed = np.zeros((k, SHARDS, B, 2), np.int64)
+    nows = now0 + np.arange(k, dtype=np.int64)
+    if SHARDS > 1:
+        gb, ga, upd = eng.empty_drain_control()
+        closed = jax.make_jaxpr(_compiled_pipeline_step_global(eng.mesh))(
+            eng.state, eng.gstate, eng.gcfg, packed, gb, ga, upd, nows)
+    else:
+        closed = jax.make_jaxpr(_compiled_pipeline_step(eng.mesh))(
+            eng.state, packed, nows)
+    del eng
+    return kernel_census(closed)
 
 
 t1, w1, packed1 = stacked_time(1)
@@ -81,10 +134,18 @@ per = (t9 - t1) / (KHI - 1)
 print(f"{mode}: K=1 {t1:.2f}ms  K={KHI} {t9:.2f}ms  -> per-window {per:.2f}ms",
       flush=True)
 
+try:
+    c = drain_census(KHI)
+    print(f"census: {c} kernels over {KHI} windows", flush=True)
+except Exception as e:  # noqa: BLE001 — census is telemetry, not a gate
+    print(f"# census failed: {type(e).__name__}: {str(e)[:160]}",
+          file=sys.stderr, flush=True)
+
 # Functional parity: replay the K=1 run's EXACT 8 windows through the
 # plain-XLA host kernel and require word-for-word equality with the
 # device's final fetch — under GUBER_PALLAS=1 this is the Pallas-vs-XLA
-# parity gate on real hardware.
+# parity gate on real hardware.  Every shard stages the same lanes over
+# its own (identical) arena shard, so one host replay covers all shards.
 import jax.numpy as jnp  # noqa: E402
 
 from gubernator_tpu.ops import kernel  # noqa: E402
@@ -95,7 +156,7 @@ for rep in range(REPS):
     st, out = kernel.window_step(st, bt, jnp.int64(now0 + rep))
 ref = np.asarray(kernel.encode_output_word(out, jnp.int64(now0 + REPS - 1)))
 assert w1.shape[-1] == ref.shape[-1], (w1.shape, ref.shape)
-match = np.array_equal(w1[0, 0], ref)
+match = all(np.array_equal(w1[0, s], ref) for s in range(SHARDS))
 print(f"parity vs host XLA kernel over {REPS} replayed windows: "
       f"{'EXACT' if match else 'MISMATCH'} "
       f"({int((w1[0, 0] != ref).sum())} differing words of {B})",

@@ -237,31 +237,9 @@ def test_bitonic_sort_is_stable_argsort():
                                       np.asarray(key)[np.asarray(want)])
 
 
-def _census(closed):
-    """Executed-kernel proxy: jaxpr equations, recursing into sub-jaxprs
-    (scan/while/cond/pjit bodies count once — per-window cost), with a
-    pallas_call counting as ONE kernel regardless of its body.  On real
-    TPU each surviving top-level op is at least one kernel launch (XLA
-    fusion only merges elementwise neighbors; the gathers, scatters, sort
-    passes and the scan skeleton stay distinct), so the ratio below is a
-    conservative stand-in for the launch-count ratio."""
-    def walk(jaxpr):
-        n = 0
-        for eqn in jaxpr.eqns:
-            if eqn.primitive.name == "pallas_call":
-                n += 1
-                continue
-            subs = []
-            for v in eqn.params.values():
-                vs = v if isinstance(v, (tuple, list)) else (v,)
-                for x in vs:
-                    if hasattr(x, "jaxpr"):
-                        subs.append(x.jaxpr)   # ClosedJaxpr
-                    elif hasattr(x, "eqns"):
-                        subs.append(x)         # Jaxpr
-            n += sum(walk(s) for s in subs) if subs else 1
-        return n
-    return walk(closed.jaxpr)
+# the shared executed-kernel proxy (also used by bench.py's per-arm census
+# and the mesh-fused drain suite)
+_census = pk.kernel_census
 
 
 def test_fused_kernel_census():

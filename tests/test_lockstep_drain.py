@@ -3,10 +3,11 @@
 The two-OS-process mesh e2e (test_mesh_serving, slow-marked) proves the
 cross-process collective contract; this suite pins the lockstep drain's
 SEMANTICS cheaply on a single-process mesh with a lockstep clock: the
-tick sequence is [compact drain, legacy stacked step], eligible traffic
-rides the drain (compact wire + fold), GLOBAL and out-of-range traffic
-rides the legacy stack, and every decision equals the reference-semantics
-oracle (tests/pyref.py).
+tick sequence is [composed drain, legacy stacked step], eligible traffic
+rides the drain (compact wire + fold), GLOBAL accumulate singles ride the
+drain's composed psum window, out-of-range traffic rides the legacy
+stack, and every decision equals the reference-semantics oracle
+(tests/pyref.py).
 """
 
 import asyncio
@@ -113,7 +114,7 @@ def test_lockstep_compact_sound_degrades_staging_not_correctness():
             (int(w.status), w.limit, w.remaining), (j, g, w)
 
 
-def test_lockstep_global_rides_legacy_stack():
+def test_lockstep_global_rides_composed_drain():
     eng, clock, b = _setup()
     eng.warmup(now=T0, k_stack=2)
     eng.register_global_keys([("lg_g", 50, 60_000, 0)], now=T0)
@@ -135,8 +136,9 @@ def test_lockstep_global_rides_legacy_stack():
     # multichip certification pins)
     assert outs[0].remaining == 49
     assert all(not r.error for r in outs)
-    # GLOBAL never staged into the drain
-    assert b.pipeline.decisions_staged == 0
+    # GLOBAL singles ride the tick drain's composed GLOBAL window now
+    # (one reconciliation psum per drain), not the legacy stack
+    assert b.pipeline.decisions_staged == 3
 
 
 @pytest.mark.slow
