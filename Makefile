@@ -1,7 +1,7 @@
 # Dev targets (the reference Makefile:1-15 has only release/docker; we add
 # the working set).
 
-.PHONY: test test-core test-pallas test-mesh-fused proto bench docker lint cluster
+.PHONY: test test-core test-pallas test-mesh-fused test-snapshot proto bench docker lint cluster
 
 test:
 	python -m pytest tests/ -x -q
@@ -20,6 +20,12 @@ test-pallas:
 # Part of tier-1 (`test-core` picks it up too); this target runs just the slice.
 test-mesh-fused:
 	python -m pytest tests/ -x -q -m "mesh_fused and not slow"
+
+# the state-lifecycle slice: snapshot/restore restart equivalence + live
+# key migration on ring change.  Part of tier-1 (`test-core` picks it up
+# too); this target runs just the slice.
+test-snapshot:
+	python -m pytest tests/ -x -q -m "snapshot and not slow"
 
 proto:
 	cd gubernator_tpu/api/proto && protoc --python_out=. gubernator.proto peers.proto

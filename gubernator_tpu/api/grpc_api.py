@@ -53,6 +53,13 @@ def add_peers_servicer(server: grpc.aio.Server, servicer) -> None:
             request_deserializer=None,
             response_serializer=None,
         ),
+        # bytes-level: the migration payload codec is state/migrate.py's
+        # (versioned JSON), not a generated proto
+        "TransferBuckets": grpc.unary_unary_rpc_method_handler(
+            servicer.TransferBuckets,
+            request_deserializer=None,
+            response_serializer=None,
+        ),
         "UpdatePeerGlobals": grpc.unary_unary_rpc_method_handler(
             servicer.UpdatePeerGlobals,
             request_deserializer=pb.UpdatePeerGlobalsReq.FromString,

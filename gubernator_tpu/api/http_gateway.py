@@ -75,10 +75,35 @@ def build_app(instance: Instance) -> web.Application:
             content_type=CONTENT_TYPE_LATEST.split(";")[0],
         )
 
-    app = web.Application()
+    # state-lifecycle admin plane (cmd/cli.py snapshot/restore): the
+    # snapshot blob travels as-is — it is already versioned + checksummed
+    async def admin_snapshot(request: web.Request) -> web.Response:
+        data = await instance.export_snapshot_bytes(
+            layout=request.query.get("layout", "auto"))
+        return web.Response(body=data,
+                            content_type="application/octet-stream")
+
+    async def admin_restore(request: web.Request) -> web.Response:
+        from gubernator_tpu.state.snapshot import SnapshotError
+        data = await request.read()
+        rebase = request.query.get("rebase_to")
+        try:
+            n = await instance.restore_snapshot_bytes(
+                data, rebase_to=int(rebase) if rebase else None)
+        except SnapshotError as e:
+            return web.json_response({"error": str(e), "code": 3},
+                                     status=400)
+        return web.json_response({"restoredKeys": n})
+
+    # a full-arena snapshot blob is tens of MB at default capacity — far
+    # past aiohttp's 1 MiB default body cap, which would 413 every real
+    # admin restore
+    app = web.Application(client_max_size=1 << 30)
     app.router.add_post("/v1/GetRateLimits", get_rate_limits)
     app.router.add_get("/v1/HealthCheck", health_check)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/v1/admin/snapshot", admin_snapshot)
+    app.router.add_post("/v1/admin/restore", admin_restore)
     return app
 
 

@@ -32,6 +32,10 @@ class ClusterNode:
 class Cluster:
     def __init__(self):
         self.nodes: List[ClusterNode] = []
+        # remembered for add_instance: a node joining later must be built
+        # with the SAME configs as the founding members
+        self._behaviors: Optional[BehaviorConfig] = None
+        self._engine: Optional[EngineConfig] = None
 
     @property
     def addresses(self) -> List[str]:
@@ -54,6 +58,51 @@ class Cluster:
         inst = self.nodes[0].instance
         owner = inst.get_peer(key)
         return self.addresses.index(owner.host)
+
+    async def _rewire(self) -> None:
+        """Install the current membership on every node (IsOwner by address
+        match, cluster.go:35-45)."""
+        for node in self.nodes:
+            infos = [PeerInfo(address=a, is_owner=(a == node.address))
+                     for a in self.addresses]
+            await node.instance.set_peers(infos)
+
+    async def add_instance(self, address: str = "127.0.0.1:0") -> ClusterNode:
+        """Grow the ring by one node, then LIVE-MIGRATE the re-homed keys:
+        after the new membership is installed everywhere, every existing
+        node diffs old->new ownership and ships its moved bucket rows to
+        their new owners (Instance.migrate_keys) — ~1/(N+1) of the key
+        space moves, everything else stays untouched."""
+        old_hosts = self.addresses
+        conf = Config(behaviors=replace(self._behaviors or BehaviorConfig()),
+                      engine=self._engine or EngineConfig(),
+                      advertise_address=address)
+        inst = Instance(conf)
+        server = GrpcServer(inst, address)
+        await server.start()
+        inst.advertise_address = server.address
+        node = ClusterNode(inst, server)
+        self.nodes.append(node)
+        await self._rewire()
+        for n in self.nodes[:-1]:
+            await n.instance.migrate_keys(old_hosts, self.addresses)
+        return node
+
+    async def remove_instance(self, idx: int) -> None:
+        """Shrink the ring: the departing node first ships EVERY key it
+        owns to the surviving membership (its migrate_keys diff is old
+        membership -> membership-without-self, so all its keys re-home),
+        then leaves the ring and stops."""
+        node = self.nodes[idx]
+        old_hosts = self.addresses
+        new_hosts = [a for a in old_hosts if a != node.address]
+        # departing node still has the OLD ring installed, so its picker
+        # can reach every destination peer while it drains itself
+        await node.instance.migrate_keys(old_hosts, new_hosts)
+        self.nodes.pop(idx)
+        await self._rewire()
+        await node.server.stop()
+        node.instance.close()
 
     async def stop(self) -> None:
         for n in self.nodes:
@@ -79,6 +128,8 @@ async def start_with(
             max_global_updates=32,
         )
     cluster = Cluster()
+    cluster._behaviors = behaviors
+    cluster._engine = engine
     try:
         for addr in addresses:
             conf = Config(behaviors=replace(behaviors), engine=engine,

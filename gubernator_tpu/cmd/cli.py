@@ -1,23 +1,35 @@
-"""Load-generation CLI: random token-bucket limits hammered in a loop.
+"""Operator CLI: load generation + state-lifecycle admin commands.
 
-Equivalent of the reference's cmd/gubernator-cli (main.go:42-85): generate
-2000 random rate-limit configs, hit them forever with concurrency 10, print
-any OVER_LIMIT responses.
+Load generation is the reference's cmd/gubernator-cli (main.go:42-85):
+generate 2000 random rate-limit configs, hit them forever with concurrency
+10, print any OVER_LIMIT responses.
 
-Run: python -m gubernator_tpu.cmd.cli <address>
+The snapshot/restore subcommands drive the daemon's HTTP admin plane
+(api/http_gateway.py), moving the versioned, checksummed snapshot blob
+(state/snapshot.py) as-is:
+
+  python -m gubernator_tpu.cmd.cli load [address]            # default
+  python -m gubernator_tpu.cmd.cli snapshot <http-addr> -o arena.snap
+  python -m gubernator_tpu.cmd.cli restore  <http-addr> arena.snap
+                                            [--rebase-to-now]
+
+For compatibility, a bare address (no subcommand) runs load generation.
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import random
+import sys
+import urllib.request
 
 from gubernator_tpu.api.types import Algorithm, RateLimitReq, Second, Status
-from gubernator_tpu.client import AsyncClient, random_string
 
 
-async def _amain(address: str, count: int, concurrency: int) -> None:
+async def _load(address: str, count: int, concurrency: int) -> None:
+    from gubernator_tpu.client import AsyncClient, random_string
     client = AsyncClient(address)
     reqs = [
         RateLimitReq(
@@ -42,14 +54,77 @@ async def _amain(address: str, count: int, concurrency: int) -> None:
         await asyncio.gather(*(hit(r) for r in reqs))
 
 
-def main() -> None:
-    p = argparse.ArgumentParser("gubernator-tpu-cli")
-    p.add_argument("address", nargs="?", default="127.0.0.1:9090")
-    p.add_argument("--count", type=int, default=2000)
-    p.add_argument("--concurrency", type=int, default=10)
-    args = p.parse_args()
+def _http_base(address: str) -> str:
+    return address if "://" in address else f"http://{address}"
+
+
+def cmd_snapshot(args) -> int:
+    url = f"{_http_base(args.address)}/v1/admin/snapshot?layout={args.layout}"
+    with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+        data = resp.read()
+    with open(args.output, "wb") as f:
+        f.write(data)
+    print(f"wrote {len(data)} bytes to {args.output}")
+    return 0
+
+
+def cmd_restore(args) -> int:
+    with open(args.file, "rb") as f:
+        data = f.read()
+    url = f"{_http_base(args.address)}/v1/admin/restore"
+    if args.rebase_to_now:
+        from gubernator_tpu.api.types import millisecond_now
+        url += f"?rebase_to={millisecond_now()}"
+    req = urllib.request.Request(
+        url, data=data, method="POST",
+        headers={"Content-Type": "application/octet-stream"})
     try:
-        asyncio.run(_amain(args.address, args.count, args.concurrency))
+        with urllib.request.urlopen(req, timeout=args.timeout) as resp:
+            body = json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        print(f"restore rejected: {e.read().decode('utf-8', 'replace')}",
+              file=sys.stderr)
+        return 1
+    print(f"restored {body.get('restoredKeys', 0)} keys")
+    return 0
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # compatibility: a bare address (or nothing) runs load generation
+    if not argv or argv[0] not in ("load", "snapshot", "restore"):
+        argv.insert(0, "load")
+
+    p = argparse.ArgumentParser("gubernator-tpu-cli")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pl = sub.add_parser("load", help="hammer random rate limits (default)")
+    pl.add_argument("address", nargs="?", default="127.0.0.1:9090")
+    pl.add_argument("--count", type=int, default=2000)
+    pl.add_argument("--concurrency", type=int, default=10)
+
+    ps = sub.add_parser("snapshot", help="pull a snapshot over HTTP admin")
+    ps.add_argument("address", help="daemon HTTP address (host:port)")
+    ps.add_argument("-o", "--output", default="arena.snap")
+    ps.add_argument("--layout", choices=("auto", "int64", "compact32"),
+                    default="auto")
+    ps.add_argument("--timeout", type=float, default=30.0)
+
+    pr = sub.add_parser("restore", help="push a snapshot over HTTP admin")
+    pr.add_argument("address", help="daemon HTTP address (host:port)")
+    pr.add_argument("file")
+    pr.add_argument("--rebase-to-now", action="store_true",
+                    help="shift all timestamps so buckets keep their "
+                    "REMAINING lifetime instead of absolute expiry")
+    pr.add_argument("--timeout", type=float, default=30.0)
+
+    args = p.parse_args(argv)
+    if args.cmd == "snapshot":
+        sys.exit(cmd_snapshot(args))
+    if args.cmd == "restore":
+        sys.exit(cmd_restore(args))
+    try:
+        asyncio.run(_load(args.address, args.count, args.concurrency))
     except KeyboardInterrupt:
         pass
 

@@ -59,6 +59,11 @@ class EngineConfig:
     global_capacity: int = 4096
     global_batch_per_shard: int = 256
     max_global_updates: int = 256
+    # Regular-key routing backend: "auto" uses the native C++ router when
+    # the extension built, False forces the Python SlotTables (env:
+    # GUBER_NATIVE=0).  Live key migration (state/migrate.py) requires the
+    # Python tables — the native router keeps fingerprints, not keys.
+    use_native: object = "auto"
     # Opt-in exact-key collision guard in the native router (env:
     # GUBER_EXACT_KEYS=1): stores full key bytes so a 64-bit fingerprint
     # collision probes onward instead of merging two keys' counters.
@@ -107,6 +112,12 @@ class DaemonConfig:
     k8s_pod_ip: str = ""
     k8s_pod_port: str = ""
     k8s_endpoints_selector: str = ""
+
+    # State lifecycle (state/snapshot.py): when snapshot_dir is set, the
+    # daemon restores the arena from it on boot and re-snapshots every
+    # snapshot_interval_ms (plus once on clean shutdown).
+    snapshot_dir: str = ""
+    snapshot_interval_ms: int = 60_000
 
     # etcd discovery
     etcd_addresses: List[str] = field(default_factory=list)
@@ -227,6 +238,10 @@ def config_from_env(env_file: Optional[str] = None) -> DaemonConfig:
     c.cache_size = int(_env("GUBER_CACHE_SIZE", str(c.cache_size)))
     c.debug = _env("GUBER_DEBUG") in ("true", "1", "yes")
 
+    c.snapshot_dir = _env("GUBER_SNAPSHOT_DIR")
+    c.snapshot_interval_ms = env_int("GUBER_SNAPSHOT_INTERVAL_MS",
+                                     c.snapshot_interval_ms, minimum=100)
+
     c.k8s_namespace = _env("GUBER_K8S_NAMESPACE")
     c.k8s_pod_ip = _env("GUBER_K8S_POD_IP")
     c.k8s_pod_port = _env("GUBER_K8S_POD_PORT")
@@ -280,6 +295,8 @@ def config_from_env(env_file: Optional[str] = None) -> DaemonConfig:
         e.batch_per_shard = int(_env("GUBER_TPU_BATCH_PER_SHARD"))
     if _env("GUBER_TPU_GLOBAL_CAPACITY"):
         e.global_capacity = int(_env("GUBER_TPU_GLOBAL_CAPACITY"))
+    if os.environ.get("GUBER_NATIVE") is not None:
+        e.use_native = "auto" if env_bool("GUBER_NATIVE", True) else False
     if _env("GUBER_EXACT_KEYS"):
         e.exact_keys = _env("GUBER_EXACT_KEYS") == "1"
     if _env("GUBER_REPLAY_CAP"):

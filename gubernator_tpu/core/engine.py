@@ -1513,6 +1513,499 @@ class RateLimitEngine:
                else sum(t.misses for t in self.tables))
         return reg + self.gtable.misses
 
+    def cache_stats(self, now: Optional[int] = None) -> dict:
+        """One coherent view of the key-map caches: hit/miss counters plus
+        free/live/expired slot occupancy (by the host expiry estimates),
+        covering the regular tables AND the GLOBAL table.  Replaces reading
+        cache_size/cache_hits/cache_misses piecemeal — a scrape sees one
+        consistent set."""
+        now = int(now) if now is not None else millisecond_now()
+        if self.native is not None:
+            live, expired, free = self.native.occupancy(now)
+            hits, misses = self.native.hits, self.native.misses
+            size = self.native.size
+        else:
+            hits = sum(t.hits for t in self.tables)
+            misses = sum(t.misses for t in self.tables)
+            size = sum(len(t) for t in self.tables)
+            live = expired = free = 0
+            for t in self.tables:
+                st = t.stats(now)
+                free += st["free"]
+                live += st["live"]
+                expired += st["expired"]
+        g = self.gtable.stats(now)
+        return {
+            "size": size + len(self.gtable),
+            "capacity": (self.num_local_shards * self.capacity_per_shard
+                         + self.global_capacity),
+            "hits": hits + self.gtable.hits,
+            "misses": misses + self.gtable.misses,
+            "free": free + g["free"],
+            "live": live + g["live"],
+            "expired": expired + g["expired"],
+        }
+
+    # ------------------------------------------------------- state lifecycle
+    #
+    # Snapshot/restore and live key migration (state/snapshot.py,
+    # state/migrate.py).  Every method here touches the device arenas and
+    # the host tables together, so callers MUST quiesce serving first: run
+    # them on the same single-thread executor that dispatches windows (the
+    # lockstep batcher's), exactly like apply_global_registration.
+
+    def _put_sharded(self, local_np, dtype):
+        """Host [S_local, ...] block -> device array with the shard
+        sharding (global [S, ...] when the mesh spans processes)."""
+        arr = np.ascontiguousarray(local_np, dtype=dtype)
+        if self.multiprocess:
+            return self._sharded_in(arr)
+        return jax.device_put(jnp.asarray(arr), self._shard_sharding)
+
+    def _put_repl(self, arr, dtype):
+        """Host [G] array -> replicated device array (every process must
+        pass identical values, as with any replicated input)."""
+        arr = np.ascontiguousarray(arr, dtype=dtype)
+        if self.multiprocess:
+            return self._repl_in(arr)
+        return jax.device_put(jnp.asarray(arr), self._repl_sharding)
+
+    def export_state(self, now: Optional[int] = None, layout: str = "auto"):
+        """Device->host export of this process's arena blocks + key maps as
+        an ArenaSnapshot.  `layout` picks the wire time-encoding ("int64" |
+        "compact32" | "auto" = compact32 iff the engine is compact-sound);
+        serialization falls back to int64 whenever compact32 cannot
+        represent the data exactly, so the choice is never lossy."""
+        from gubernator_tpu.state.snapshot import ArenaSnapshot, SnapshotError
+        now = self._resolve_now(now)
+        planes = {n: np.asarray(self._fetch_local(getattr(self.state, n)))
+                  for n in BucketState._fields}
+        gplanes = {n: np.asarray(jax.device_get(getattr(self.gstate, n)))
+                   for n in BucketState._fields}
+        gcfg = {n: np.asarray(jax.device_get(getattr(self.gcfg, n)))
+                for n in GlobalConfig._fields}
+
+        tables, native_tables = [], []
+        if self.native is not None:
+            if self.native.exact:
+                raise SnapshotError(
+                    "exact-keys native router cannot export its key map "
+                    "(key bytes are not part of the export format); disable "
+                    "GUBER_EXACT_KEYS / EngineConfig.exact_keys to snapshot")
+            backend = "native"
+            for s in range(self.num_local_shards):
+                native_tables.append(self.native.export_keys(s))
+        else:
+            backend = "python"
+            for t in self.tables:
+                ents = t.export_entries()
+                tables.append((
+                    [e[0] for e in ents],
+                    np.asarray([e[1] for e in ents], np.int32),
+                    np.asarray([e[2] for e in ents], np.int64)))
+        gents = self.gtable.export_entries()
+        gtable = ([e[0] for e in gents],
+                  np.asarray([e[1] for e in gents], np.int32),
+                  np.asarray([e[2] for e in gents], np.int64))
+
+        if layout == "auto":
+            layout = "compact32" if self._compact_sound else "int64"
+        return ArenaSnapshot(
+            now=now, layout=layout,
+            num_shards=self.num_shards,
+            capacity_per_shard=self.capacity_per_shard,
+            global_capacity=self.global_capacity,
+            num_local_shards=self.num_local_shards,
+            local_shard_offset=self.local_shard_offset,
+            compact_sound=self._compact_sound,
+            backend=backend,
+            planes=planes, gplanes=gplanes, gcfg=gcfg,
+            tables=tables, native_tables=native_tables, gtable=gtable,
+            gpending=sorted(self._gpending),
+        )
+
+    def import_state(self, snap, rebase_to: Optional[int] = None) -> None:
+        """Replace the arenas + key maps with a snapshot's contents.
+
+        By default times stay ABSOLUTE: downtime between export and restore
+        counts against every TTL, exactly as if the process had kept
+        running (restart equivalence vs an uninterrupted oracle).
+        `rebase_to` instead shifts every live timestamp by
+        (rebase_to - snap.now), preserving each bucket's remaining lifetime
+        across a clock-domain change."""
+        from gubernator_tpu.state.snapshot import SnapshotError
+        for attr in ("num_shards", "capacity_per_shard", "global_capacity",
+                     "num_local_shards", "local_shard_offset"):
+            if getattr(snap, attr) != getattr(self, attr):
+                raise SnapshotError(
+                    f"snapshot geometry mismatch: {attr}={getattr(snap, attr)}"
+                    f" but engine has {getattr(self, attr)}")
+        if snap.backend == "native" and self.native is None:
+            raise SnapshotError(
+                "snapshot holds a native fingerprint table but this engine "
+                "routes in Python; key strings cannot be recovered from "
+                "fingerprints")
+        if self.native is not None and self.native.exact:
+            raise SnapshotError(
+                "exact-keys native router cannot import a snapshot key map "
+                "(stored keys would stay empty and every lookup would "
+                "collide); disable exact_keys to restore")
+        shift = 0 if rebase_to is None else int(rebase_to) - snap.now
+
+        def shifted(planes):
+            if shift == 0:
+                return planes
+            out = dict(planes)
+            live = planes["expire"] != 0
+            for name in ("tstamp", "expire"):
+                a = planes[name].copy()
+                a[live] += shift
+                out[name] = a
+            return out
+
+        rp, gp = shifted(snap.planes), shifted(snap.gplanes)
+        self.state = BucketState(
+            limit=self._put_sharded(rp["limit"], np.int64),
+            duration=self._put_sharded(rp["duration"], np.int64),
+            remaining=self._put_sharded(rp["remaining"], np.int64),
+            tstamp=self._put_sharded(rp["tstamp"], np.int64),
+            expire=self._put_sharded(rp["expire"], np.int64),
+            algo=self._put_sharded(rp["algo"], np.int32),
+        )
+        self.gstate = BucketState(
+            limit=self._put_repl(gp["limit"], np.int64),
+            duration=self._put_repl(gp["duration"], np.int64),
+            remaining=self._put_repl(gp["remaining"], np.int64),
+            tstamp=self._put_repl(gp["tstamp"], np.int64),
+            expire=self._put_repl(gp["expire"], np.int64),
+            algo=self._put_repl(gp["algo"], np.int32),
+        )
+        self.gcfg = GlobalConfig(
+            limit=self._put_repl(snap.gcfg["limit"], np.int64),
+            duration=self._put_repl(snap.gcfg["duration"], np.int64),
+            algo=self._put_repl(snap.gcfg["algo"], np.int32),
+        )
+
+        if snap.backend == "native":
+            for s in range(self.num_local_shards):
+                fp, slots, exps = snap.native_tables[s]
+                self.native.import_keys(
+                    s, np.asarray(fp, np.uint64), np.asarray(slots, np.int32),
+                    np.asarray(exps, np.int64) + shift)
+        elif self.native is not None:
+            # python-table snapshot into a native-routed engine: recompute
+            # the fingerprints the C router would have assigned (same
+            # FNV-1a 64, host_router.cc fnv1a64).  Expiry comes from the
+            # DEVICE plane, not the table: the Python table's estimate may
+            # lag the kernel (leaky hits extend expire on device only),
+            # which is harmless under Python routing (the kernel owns lazy
+            # expiry) but the native router trusts its host expire at
+            # lookup and would spuriously re-init a still-live bucket.
+            for s, (keys, slots, exps) in enumerate(snap.tables):
+                fp = np.asarray([_fnv1a64(k.encode("utf-8")) for k in keys],
+                                np.uint64)
+                si = np.asarray(slots, np.int64)
+                dev = rp["expire"][s, si] if len(si) else \
+                    np.empty(0, np.int64)
+                self.native.import_keys(
+                    s, fp, np.asarray(slots, np.int32),
+                    np.maximum(np.asarray(exps, np.int64) + shift, dev))
+        else:
+            for t, (keys, slots, exps) in zip(self.tables, snap.tables):
+                t.restore_entries(zip(
+                    keys, np.asarray(slots, np.int64).tolist(),
+                    (np.asarray(exps, np.int64) + shift).tolist()))
+        gkeys, gslots, gexps = snap.gtable if snap.gtable else ([], [], [])
+        self.gtable.restore_entries(zip(
+            gkeys, np.asarray(gslots, np.int64).tolist(),
+            (np.asarray(gexps, np.int64) + shift).tolist()))
+        self._gpending = set(snap.gpending)
+        if not snap.compact_sound:
+            # the snapshotted arena held out-of-range configs; the compact
+            # wire could saturate serving them, same guard as the live path
+            self._compact_sound = False
+            self._compact_enabled = False
+
+    # Live key migration (state/migrate.py) — cluster mode only.  The mesh
+    # resizes by re-sharding the arena, not by moving keys, and the native
+    # router keeps fingerprints rather than key strings, so the row-level
+    # API below requires single-process engines routing in Python.
+
+    def _check_migratable(self) -> None:
+        if self.native is not None:
+            raise RuntimeError(
+                "native router does not retain key strings; live migration "
+                "needs the Python tables (EngineConfig use_native=False)")
+        if self.multiprocess:
+            raise RuntimeError(
+                "live key migration applies to cluster mode (one process "
+                "per instance); a mesh resizes by re-sharding the arena")
+
+    def local_keys(self) -> List[str]:
+        """Every committed regular key resident on this engine."""
+        self._check_migratable()
+        out: List[str] = []
+        for t in self.tables:
+            out.extend(k for k in t.keys() if not t.is_pending(k))
+        return out
+
+    def global_keys(self) -> List[str]:
+        """Every committed GLOBAL key registered on this engine."""
+        return [k for k in self.gtable.keys()
+                if not self.gtable.is_pending(k)]
+
+    def export_rows(self, keys: Sequence[str]) -> List[dict]:
+        """Gather the live device rows for `keys` (regular arena) as host
+        dicts.  Keys not resident here, still pending their initializing
+        dispatch, or whose device row was never written are skipped."""
+        self._check_migratable()
+        picks = []
+        for key in keys:
+            s = shard_of(key, self.num_shards)
+            t = self.tables[s]
+            slot = t.peek(key)
+            if slot is None or t.is_pending(key):
+                continue
+            picks.append((key, s, slot))
+        if not picks:
+            return []
+        n = len(picks)
+        m = _pad_pow2(n)
+        si = np.full(m, self.num_shards, np.int32)       # OOB pad -> fill 0
+        li = np.full(m, self.capacity_per_shard, np.int32)
+        si[:n] = [p[1] for p in picks]
+        li[:n] = [p[2] for p in picks]
+        got = _gather_rows_jit(self.state, jnp.asarray(si), jnp.asarray(li))
+        vals = {f: np.asarray(getattr(got, f))[:n]
+                for f in BucketState._fields}
+        rows = []
+        for j, (key, _s, _slot) in enumerate(picks):
+            if vals["expire"][j] == 0:
+                continue  # registered but never device-initialized
+            rows.append({
+                "key": key,
+                "limit": int(vals["limit"][j]),
+                "duration": int(vals["duration"][j]),
+                "remaining": int(vals["remaining"][j]),
+                "tstamp": int(vals["tstamp"][j]),
+                "expire": int(vals["expire"][j]),
+                "algo": int(vals["algo"][j]),
+            })
+        return rows
+
+    def import_rows(self, rows: Sequence[dict],
+                    now: Optional[int] = None) -> tuple:
+        """Install migrated regular rows into the local arena.  Returns
+        (imported, skipped_stale).
+
+        Init-flag semantics: an incoming row NEVER clobbers a fresher local
+        entry.  Fresher means a local pending-init entry (a request already
+        arrived here and its slot initializes this window — created after
+        the source stopped being authoritative) or a committed local row
+        whose device expire >= the incoming row's."""
+        self._check_migratable()
+        now = self._resolve_now(now)
+        skipped = 0
+        cand = []
+        for row in rows:
+            key = row["key"]
+            s = shard_of(key, self.num_shards)
+            t = self.tables[s]
+            if t.is_pending(key):
+                skipped += 1
+                continue
+            cand.append((key, s, t.peek(key), row))
+        # one gather for every already-resident key's device expire
+        resident = [(i, c[1], c[2]) for i, c in enumerate(cand)
+                    if c[2] is not None]
+        dev_expire = {}
+        if resident:
+            n = len(resident)
+            m = _pad_pow2(n)
+            si = np.full(m, self.num_shards, np.int32)
+            li = np.full(m, self.capacity_per_shard, np.int32)
+            si[:n] = [r[1] for r in resident]
+            li[:n] = [r[2] for r in resident]
+            exp = np.asarray(_gather_rows_jit(
+                self.state, jnp.asarray(si), jnp.asarray(li)).expire)[:n]
+            dev_expire = {r[0]: int(exp[j]) for j, r in enumerate(resident)}
+        winners = []
+        for i, (key, s, slot, row) in enumerate(cand):
+            if i in dev_expire and dev_expire[i] >= row["expire"]:
+                skipped += 1
+                continue
+            winners.append((key, s, row))
+        if not winners:
+            return 0, skipped
+        n = len(winners)
+        m = _pad_pow2(n)
+        si = np.full(m, self.num_shards, np.int32)      # OOB pad -> dropped
+        li = np.full(m, self.capacity_per_shard, np.int32)
+        vals = {f: np.zeros(m, np.int64) for f in BucketState._fields}
+        for j, (key, s, row) in enumerate(winners):
+            si[j] = s
+            li[j] = self.tables[s].upsert(key, now, row["expire"])
+            for f in BucketState._fields:
+                vals[f][j] = row[f]
+        self.state = _scatter_rows_jit(
+            self.state, jnp.asarray(si), jnp.asarray(li),
+            BucketState(**{f: jnp.asarray(vals[f]) for f in
+                           BucketState._fields}))
+        return n, skipped
+
+    def export_global_rows(self, keys: Sequence[str]) -> List[dict]:
+        """Gather GLOBAL rows (replicated arena state + registration
+        config) for re-registration on a new owner.  A registered key whose
+        state row was never written still exports (expire 0): its CONFIG
+        must move for the new owner to serve it."""
+        picks = []
+        for key in keys:
+            slot = self.gtable.peek(key)
+            if slot is None or self.gtable.is_pending(key):
+                continue
+            picks.append((key, slot))
+        if not picks:
+            return []
+        n = len(picks)
+        m = _pad_pow2(n)
+        gi = np.full(m, self.global_capacity, np.int32)
+        gi[:n] = [p[1] for p in picks]
+        gst = _gather_grows_jit(self.gstate, jnp.asarray(gi))
+        gcf = _gather_gcfg_jit(self.gcfg, jnp.asarray(gi))
+        rows = []
+        for j, (key, _slot) in enumerate(picks):
+            rows.append({
+                "key": key,
+                "cfg_limit": int(np.asarray(gcf.limit)[j]),
+                "cfg_duration": int(np.asarray(gcf.duration)[j]),
+                "cfg_algo": int(np.asarray(gcf.algo)[j]),
+                **{f: int(np.asarray(getattr(gst, f))[j])
+                   for f in BucketState._fields},
+            })
+        return rows
+
+    def import_global_rows(self, rows: Sequence[dict],
+                           now: Optional[int] = None) -> tuple:
+        """Register + install migrated GLOBAL rows.  Same staleness rule as
+        import_rows; a row with expire 0 registers config only (its state
+        row stays dead until traffic initializes it)."""
+        now = self._resolve_now(now)
+        skipped = 0
+        winners = []
+        for row in rows:
+            key = row["key"]
+            if self.gtable.is_pending(key):
+                skipped += 1
+                continue
+            slot = self.gtable.peek(key)
+            if slot is not None:
+                dev = int(np.asarray(
+                    jax.device_get(self.gstate.expire[slot])))
+                if dev >= row["expire"] and not (dev == 0
+                                                 and row["expire"] == 0):
+                    skipped += 1
+                    continue
+            winners.append(row)
+        if not winners:
+            return 0, skipped
+        n = len(winners)
+        m = _pad_pow2(n)
+        gi = np.full(m, self.global_capacity, np.int32)
+        svals = {f: np.zeros(m, np.int64) for f in BucketState._fields}
+        cvals = {f: np.zeros(m, np.int64) for f in GlobalConfig._fields}
+        for j, row in enumerate(winners):
+            est = row["expire"] if row["expire"] else now + row["cfg_duration"]
+            gi[j] = self.gtable.upsert(row["key"], now, est)
+            for f in BucketState._fields:
+                svals[f][j] = row[f]
+            cvals["limit"][j] = row["cfg_limit"]
+            cvals["duration"][j] = row["cfg_duration"]
+            cvals["algo"][j] = row["cfg_algo"]
+            self._gpending.discard(row["key"])
+        gij = jnp.asarray(gi)
+        self.gstate = _scatter_grows_jit(
+            self.gstate, gij,
+            BucketState(**{f: jnp.asarray(svals[f])
+                           for f in BucketState._fields}))
+        self.gcfg = _scatter_gcfg_jit(
+            self.gcfg, gij,
+            GlobalConfig(**{f: jnp.asarray(cvals[f])
+                            for f in GlobalConfig._fields}))
+        return n, skipped
+
+    def remove_keys(self, keys: Sequence[str]) -> int:
+        """Drop regular keys from the host tables after they migrated away.
+        The device rows become dead tenants: slot reuse re-initializes them
+        (is_init), and routing no longer sends these keys here."""
+        self._check_migratable()
+        removed = 0
+        for key in keys:
+            s = shard_of(key, self.num_shards)
+            if key in self.tables[s]:
+                self.tables[s].remove(key)
+                removed += 1
+        return removed
+
+
+def _pad_pow2(n: int) -> int:
+    """Pad gather/scatter index vectors to a power of two (>= 8) so the
+    jitted helpers compile for a handful of shapes, not one per call."""
+    return max(8, 1 << (n - 1).bit_length())
+
+
+def _fnv1a64(data: bytes) -> int:
+    """FNV-1a 64 over key bytes — bit-identical to host_router.cc fnv1a64,
+    for restoring a Python-table snapshot into a native-routed engine.
+    The seed below is the router's literal constant, NOT the textbook FNV
+    offset basis (the .cc drops the basis's last digit); what matters here
+    is agreeing with the fingerprints the C side assigns, so mirror the
+    code, not the spec.  0 is remapped to 1 (0 marks an empty table cell)."""
+    h = 1469598103934665603
+    for b in data:
+        h ^= b
+        h = (h * 1099511628211) & 0xFFFFFFFFFFFFFFFF
+    return h if h else 1
+
+
+@jax.jit
+def _gather_rows_jit(state: BucketState, si, li) -> BucketState:
+    # OOB padded indices read as 0 (mode="fill"); callers slice them off
+    return jax.tree.map(
+        lambda a: a.at[si, li].get(mode="fill", fill_value=0), state)
+
+
+@jax.jit
+def _scatter_rows_jit(state: BucketState, si, li, vals) -> BucketState:
+    return jax.tree.map(
+        lambda a, v: a.at[si, li].set(v.astype(a.dtype), mode="drop"),
+        state, vals)
+
+
+@jax.jit
+def _gather_grows_jit(gstate: BucketState, gi) -> BucketState:
+    return jax.tree.map(
+        lambda a: a.at[gi].get(mode="fill", fill_value=0), gstate)
+
+
+@jax.jit
+def _scatter_grows_jit(gstate: BucketState, gi, vals) -> BucketState:
+    return jax.tree.map(
+        lambda a, v: a.at[gi].set(v.astype(a.dtype), mode="drop"),
+        gstate, vals)
+
+
+@jax.jit
+def _gather_gcfg_jit(gcfg: GlobalConfig, gi) -> GlobalConfig:
+    return jax.tree.map(
+        lambda a: a.at[gi].get(mode="fill", fill_value=0), gcfg)
+
+
+@jax.jit
+def _scatter_gcfg_jit(gcfg: GlobalConfig, gi, vals) -> GlobalConfig:
+    return jax.tree.map(
+        lambda a, v: a.at[gi].set(v.astype(a.dtype), mode="drop"),
+        gcfg, vals)
+
 
 def _use_pallas() -> bool:
     """Opt-in Pallas lowering (GUBER_PALLAS=1) for the window kernel and

@@ -70,6 +70,7 @@ class Instance:
             global_capacity=e.global_capacity,
             global_batch_per_shard=e.global_batch_per_shard,
             max_global_updates=e.max_global_updates,
+            use_native=e.use_native,
             exact_keys=e.exact_keys,
             replay_cap=e.replay_cap,
         )
@@ -414,6 +415,124 @@ class Instance:
             np.asarray(points, np.uint32),
             np.arange(len(points), dtype=np.int32), tuple(peers), self_idx)
         pipe.rpc_enabled = True
+
+    # ------------------------------------------------------- state lifecycle
+
+    async def _quiesced(self, fn):
+        """Run engine-mutating work on the batcher's single dispatch
+        thread: serialized with every in-flight window, exactly like
+        apply_global_registration — the quiesce point for snapshot/restore
+        and migration."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self.batcher._executor, fn)
+
+    async def export_snapshot(self, layout: str = "auto", now=None):
+        """Quiesced device->host export (state/snapshot.ArenaSnapshot)."""
+        return await self._quiesced(
+            lambda: self.engine.export_state(now=now, layout=layout))
+
+    async def save_snapshot(self, path: str, layout: str = "auto") -> int:
+        """Export + atomic write; returns bytes written.  The quiesce pause
+        covers only the device->host export — serialization and file I/O
+        run off the dispatch thread."""
+        import time as _time
+        from gubernator_tpu.state import snapshot as snapmod
+        start = _time.monotonic()
+        snap = await self.export_snapshot(layout)
+        size = snapmod.save(snap, path)
+        self.metrics.observe_snapshot(_time.monotonic() - start, size,
+                                      ok=True)
+        log.info("snapshot: %d keys, %d bytes -> %s", snap.total_keys(),
+                 size, path)
+        return size
+
+    async def export_snapshot_bytes(self, layout: str = "auto") -> bytes:
+        from gubernator_tpu.state import snapshot as snapmod
+        return snapmod.dumps(await self.export_snapshot(layout))
+
+    async def restore_snapshot_bytes(self, data: bytes,
+                                     rebase_to=None) -> int:
+        """Parse + quiesced import; returns the number of restored keys.
+        Raises SnapshotError on a bad blob (callers decide whether a cold
+        start is acceptable — restore-on-boot degrades, an explicit admin
+        restore must surface the failure)."""
+        from gubernator_tpu.state import snapshot as snapmod
+        snap = snapmod.loads(data)
+        await self._quiesced(
+            lambda: self.engine.import_state(snap, rebase_to=rebase_to))
+        return snap.total_keys()
+
+    async def transfer_buckets(self, payload: bytes) -> bytes:
+        """Dest side of live migration: import shipped rows, never
+        clobbering a fresher local entry (engine.import_rows)."""
+        from gubernator_tpu.api.types import millisecond_now
+        from gubernator_tpu.state import migrate
+        regular, global_ = migrate.decode_rows(payload)
+        now = millisecond_now()
+        imp = sk = gimp = gsk = 0
+        if regular:
+            imp, sk = await self._quiesced(
+                lambda: self.engine.import_rows(regular, now=now))
+        if global_:
+            gimp, gsk = await self._quiesced(
+                lambda: self.engine.import_global_rows(global_, now=now))
+        self.metrics.observe_migration(imported=imp + gimp,
+                                       skipped_stale=sk + gsk)
+        if imp or gimp or sk or gsk:
+            log.info("migration import: %d rows (+%d GLOBAL), "
+                     "%d stale skipped", imp, gimp, sk + gsk)
+        return migrate.encode_ack(imp, sk, gimp, gsk)
+
+    async def migrate_keys(self, old_hosts: Sequence[str],
+                           new_hosts: Sequence[str]) -> dict:
+        """Source side of live migration, run after set_peers installed the
+        NEW ring: diff old->new ownership over the keys resident here, ship
+        each re-homed key's live bucket row to its new owner, then drop the
+        moved regular keys locally.  GLOBAL keys re-register on the new
+        owner but keep their local replica (every node serves GLOBAL reads).
+
+        Returns {"moved", "gmoved", "imported", "skipped_stale"} totals."""
+        from gubernator_tpu.state import migrate
+        keys = await self._quiesced(self.engine.local_keys)
+        gkeys = await self._quiesced(self.engine.global_keys)
+        moved = migrate.ownership_diff(keys, old_hosts, new_hosts)
+        gmoved = migrate.ownership_diff(gkeys, old_hosts, new_hosts)
+        # keys this node no longer owns move OUT; anything re-homed TO this
+        # node is someone else's export
+        self_host = self.advertise_address
+        totals = {"moved": 0, "gmoved": 0, "imported": 0, "skipped_stale": 0}
+        for dest in sorted(set(moved) | set(gmoved)):
+            if dest == self_host:
+                continue
+            dkeys = moved.get(dest, [])
+            dgkeys = gmoved.get(dest, [])
+            rows = await self._quiesced(
+                lambda ks=dkeys: self.engine.export_rows(ks))
+            grows = await self._quiesced(
+                lambda ks=dgkeys: self.engine.export_global_rows(ks))
+            peer = self._picker.get_by_host(dest)
+            if peer is None:
+                log.warning("migration: new owner %s not connected; "
+                            "%d keys restart cold there", dest,
+                            len(dkeys) + len(dgkeys))
+                continue
+            ack = migrate.decode_ack(await peer.transfer_buckets(
+                migrate.encode_rows(rows, grows)))
+            # moved regular keys leave the host table either way: the dest
+            # is authoritative now (a stale skip means it was ALREADY
+            # fresher), and routing no longer brings them here
+            await self._quiesced(
+                lambda ks=dkeys: self.engine.remove_keys(ks))
+            totals["moved"] += len(dkeys)
+            totals["gmoved"] += len(dgkeys)
+            totals["imported"] += ack["imported"] + ack["gimported"]
+            totals["skipped_stale"] += (ack["skipped_stale"]
+                                        + ack["gskipped_stale"])
+        self.metrics.observe_migration(moved=totals["moved"]
+                                       + totals["gmoved"])
+        if totals["moved"] or totals["gmoved"]:
+            log.info("migration out: %s", totals)
+        return totals
 
     def close(self) -> None:
         self.global_mgr.stop()

@@ -48,6 +48,27 @@ class Daemon:
         self.grpc: Optional[GrpcServer] = None
         self.http: Optional[HttpGateway] = None
         self.pool = None
+        self._snapshot_task: Optional[asyncio.Task] = None
+
+    def _snapshot_file(self) -> str:
+        from gubernator_tpu.state.snapshot import snapshot_path
+        eng = self.instance.engine
+        return snapshot_path(self.conf.snapshot_dir,
+                             local_shard_offset=eng.local_shard_offset,
+                             multiprocess=eng.multiprocess)
+
+    async def _snapshot_once(self) -> None:
+        try:
+            await self.instance.save_snapshot(self._snapshot_file())
+        except Exception:
+            self.instance.metrics.observe_snapshot(0.0, 0, ok=False)
+            log.exception("periodic snapshot failed")
+
+    async def _snapshot_loop(self) -> None:
+        interval = self.conf.snapshot_interval_ms / 1000.0
+        while True:
+            await asyncio.sleep(interval)
+            await self._snapshot_once()
 
     async def start(self) -> None:
         c = self.conf
@@ -103,6 +124,25 @@ class Daemon:
         else:
             self.instance.engine.warmup()
 
+        # State lifecycle: restore the arena BEFORE serving (a corrupt or
+        # missing snapshot degrades to a cold start, never a failed boot),
+        # then re-snapshot periodically and once on clean shutdown.  In
+        # mesh mode every process restores its own shard blocks from the
+        # shared directory at the same pre-lockstep point.
+        if c.snapshot_dir:
+            import os as _os
+            _os.makedirs(c.snapshot_dir, exist_ok=True)
+            from gubernator_tpu.state.snapshot import restore_engine
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                self.instance.batcher._executor,
+                lambda: restore_engine(self.instance.engine,
+                                       self._snapshot_file(),
+                                       metrics=self.instance.metrics))
+            self._snapshot_task = asyncio.create_task(self._snapshot_loop())
+            log.info("snapshots -> %s every %dms", c.snapshot_dir,
+                     c.snapshot_interval_ms)
+
         self.grpc = GrpcServer(self.instance, c.grpc_listen_address)
         await self.grpc.start()
         log.info("gRPC listening on %s", self.grpc.address)
@@ -156,6 +196,15 @@ class Daemon:
 
     async def stop(self) -> None:
         # shutdown order mirrors main.go:127-139: discovery, http, grpc
+        if self._snapshot_task is not None:
+            self._snapshot_task.cancel()
+            try:
+                await self._snapshot_task
+            except asyncio.CancelledError:
+                pass
+            # final snapshot while the engine is still serving-quiesced:
+            # a clean shutdown loses zero decisions
+            await self._snapshot_once()
         if self.pool is not None:
             await self.pool.close()
         if self.http is not None:

@@ -101,6 +101,20 @@ def _load() -> Optional[ctypes.CDLL]:
             i64p, i64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
             i32p, i32p, i32p, i64p, u8p, ctypes.c_int64,
         ]
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.router_export_keys.restype = ctypes.c_int64
+        lib.router_export_keys.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, u64p, i32p, i64p,
+        ]
+        lib.router_import_keys.restype = ctypes.c_int64
+        lib.router_import_keys.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, u64p, i32p, i64p,
+            ctypes.c_int64,
+        ]
+        lib.router_occupancy.restype = None
+        lib.router_occupancy.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, i64p, i64p, i64p,
+        ]
         _lib = lib
         return _lib
 
@@ -131,6 +145,7 @@ class NativeRouter:
             num_global_shards, shard_offset, num_shards, capacity_per_shard)
         self.num_shards = num_shards
         self.capacity_per_shard = capacity_per_shard
+        self.exact = False
 
     def __del__(self):
         handle = getattr(self, "_handle", None)
@@ -202,6 +217,7 @@ class NativeRouter:
         fingerprint collision then probes onward instead of merging two
         keys' counters).  Call before any key is inserted."""
         self._lib.router_set_exact(self._handle)
+        self.exact = True
 
     def set_replay_cap(self, cap: int) -> None:
         """Bound on a NON-uniform duplicate-key run per device window:
@@ -318,6 +334,50 @@ class NativeRouter:
         if m < 0:
             raise RuntimeError("fastpath_encode_w: response buffer too small")
         return m
+
+    def export_keys(self, shard: int):
+        """One local shard's resident committed entries, oldest first:
+        (fp uint64[n], slot int32[n], expire int64[n]) — entry index ==
+        device slot, so a snapshot needs no key strings to stay coherent
+        with the restored arena planes."""
+        cap = self.capacity_per_shard
+        fp = np.empty(cap, np.uint64)
+        slot = np.empty(cap, np.int32)
+        expire = np.empty(cap, np.int64)
+        n = self._lib.router_export_keys(
+            self._handle, shard, _ptr(fp, ctypes.c_uint64),
+            _ptr(slot, ctypes.c_int32), _ptr(expire, ctypes.c_int64))
+        return fp[:n].copy(), slot[:n].copy(), expire[:n].copy()
+
+    def import_keys(self, shard: int, fp: np.ndarray, slot: np.ndarray,
+                    expire: np.ndarray) -> None:
+        """Rebuild one local shard from export_keys output (oldest first).
+        Raises on invalid slots or when the exact-key guard is active
+        (exports carry no key bytes)."""
+        fp = np.ascontiguousarray(fp, np.uint64)
+        slot = np.ascontiguousarray(slot, np.int32)
+        expire = np.ascontiguousarray(expire, np.int64)
+        rc = self._lib.router_import_keys(
+            self._handle, shard, _ptr(fp, ctypes.c_uint64),
+            _ptr(slot, ctypes.c_int32), _ptr(expire, ctypes.c_int64),
+            len(fp))
+        if rc == -2:
+            raise RuntimeError(
+                "exact-keys native router cannot import a fingerprint-only "
+                "snapshot")
+        if rc != 0:
+            raise ValueError("invalid or duplicate slot in key-map import")
+
+    def occupancy(self, now: int):
+        """(live, expired, free) slot counts over all local shards, judged
+        by the host expiry estimate (engine.cache_stats)."""
+        live = np.zeros(1, np.int64)
+        expired = np.zeros(1, np.int64)
+        free_slots = np.zeros(1, np.int64)
+        self._lib.router_occupancy(
+            self._handle, now, _ptr(live, ctypes.c_int64),
+            _ptr(expired, ctypes.c_int64), _ptr(free_slots, ctypes.c_int64))
+        return int(live[0]), int(expired[0]), int(free_slots[0])
 
     def heap_size(self, shard: int = 0) -> int:
         """Expiry-heap nodes (live + draining) for one shard — lets tests

@@ -1426,4 +1426,93 @@ int64_t router_misses(Router* r) {
   return total;
 }
 
+// ---- state lifecycle (gubernator_tpu/state/snapshot.py) -------------------
+
+// Export one local shard's resident, committed entries oldest-first (LRU
+// tail -> head): fingerprint, device slot (entry index IS the slot), and
+// host expiry estimate.  Output buffers must hold `capacity` items.
+// Pending entries are skipped — their device rows were never written, so a
+// snapshot of them would resurrect the slot's previous tenant.
+int64_t router_export_keys(Router* r, int32_t shard, uint64_t* out_fp,
+                           int32_t* out_slot, int64_t* out_expire) {
+  Shard* s = &r->shards[shard];
+  int64_t n = 0;
+  for (int32_t e = s->lru_tail; e != NIL; e = s->prev[e]) {
+    if (s->pending[e]) continue;
+    out_fp[n] = s->fp[e];
+    out_slot[n] = e;
+    out_expire[n] = s->expire[e];
+    n++;
+  }
+  return n;
+}
+
+// Rebuild one local shard from router_export_keys output (oldest first).
+// Each entry lands at its exported entry index — the index is the device
+// slot the restored arena planes address.  Returns 0; -1 on an invalid or
+// duplicate slot; -2 when the exact-key guard is on (key bytes are not
+// part of the export, and fingerprint-only entries would make every
+// exact-mode lookup probe past them forever).
+int64_t router_import_keys(Router* r, int32_t shard, const uint64_t* fps,
+                           const int32_t* slots, const int64_t* expires,
+                           int64_t n) {
+  Shard* s = &r->shards[shard];
+  if (s->keys != nullptr) return -2;
+  int32_t capacity = s->capacity;
+  for (int64_t i = 0; i < n; i++)
+    if (slots[i] < 0 || slots[i] >= capacity) return -1;
+  for (uint32_t i = 0; i <= s->mask; i++) s->cells[i] = NIL;
+  s->heap_len = 0;
+  if (s->heap_old != nullptr) {
+    free(s->heap_old);
+    s->heap_old = nullptr;
+    s->heap_old_len = 0;
+  }
+  s->lru_head = s->lru_tail = NIL;
+  memset(s->pending, 0, (size_t)capacity);
+  memset(s->seq, 0, (size_t)capacity * sizeof(uint32_t));
+  uint8_t* used = (uint8_t*)calloc(capacity, 1);
+  for (int64_t i = 0; i < n; i++) {
+    int32_t e = slots[i];
+    if (used[e]) {
+      free(used);
+      return -1;
+    }
+    used[e] = 1;
+    uint32_t cell = (uint32_t)(fps[i] & s->mask);
+    while (s->cells[cell] != NIL) cell = (cell + 1) & s->mask;
+    s->cells[cell] = e;
+    s->cell_of[e] = cell;
+    s->fp[e] = fps[i];
+    s->expire[e] = expires[i];
+    lru_push_front(s, e);  // oldest-first input => head ends up MRU
+    heap_push(s, expires[i], e);
+  }
+  // rebuild the free list so pops come back ascending, like shard_init
+  s->free_top = 0;
+  for (int32_t e = capacity - 1; e >= 0; e--)
+    if (!used[e]) s->free_list[s->free_top++] = e;
+  free(used);
+  s->size = n;
+  return 0;
+}
+
+// Occupancy by the host expiry estimate over all local shards: live and
+// expired resident entries plus free slots (engine.cache_stats surface).
+void router_occupancy(Router* r, int64_t now, int64_t* out_live,
+                      int64_t* out_expired, int64_t* out_free) {
+  int64_t live = 0, expired = 0, free_slots = 0;
+  for (int32_t si = 0; si < r->num_shards; si++) {
+    Shard* s = &r->shards[si];
+    free_slots += s->capacity - s->size;
+    for (int32_t e = s->lru_head; e != NIL; e = s->next[e]) {
+      if (s->expire[e] >= now) live++;
+      else expired++;
+    }
+  }
+  *out_live = live;
+  *out_expired = expired;
+  *out_free = free_slots;
+}
+
 }  // extern "C"

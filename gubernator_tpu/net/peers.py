@@ -33,6 +33,7 @@ class PeerClient:
         self.channel = grpc.aio.insecure_channel(host)
         self.stub = PeersV1Stub(self.channel)
         self._raw_batch = None  # bytes-level relay, built on first use
+        self._raw_transfer = None  # bytes-level bucket-migration lane
         self._pending: List[tuple] = []  # (req, future)
         self._interval: Optional[ArmedInterval] = None
         self._waiter: Optional[asyncio.Task] = None
@@ -79,6 +80,18 @@ class PeerClient:
                 request_serializer=lambda b: b,
                 response_deserializer=lambda b: b)
         return await self._raw_batch(data, timeout=self.conf.batch_timeout)
+
+    async def transfer_buckets(self, payload: bytes) -> bytes:
+        """Ship migrated bucket rows to this peer (state/migrate.py wire
+        payload) and return its ack.  Bytes-level like the raw batch relay:
+        the codec lives in one module, not in generated protos."""
+        if self._raw_transfer is None:
+            self._raw_transfer = self.channel.unary_unary(
+                "/pb.gubernator.PeersV1/TransferBuckets",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b)
+        return await self._raw_transfer(payload,
+                                        timeout=self.conf.batch_timeout)
 
     async def register_globals(self, specs: List[tuple]) -> None:
         """Forward (key, limit, duration, algorithm) registrations to the

@@ -110,6 +110,47 @@ class Metrics:
             buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
             registry=self.registry,
         )
+        # state lifecycle (state/snapshot.py, state/migrate.py): the slot
+        # occupancy gauges come from engine.cache_stats at scrape time
+        self.cache_slots = Gauge(
+            "guber_tpu_cache_slots",
+            "Arena slot occupancy by state.",
+            ["state"],  # free | live | expired
+            registry=self.registry,
+        )
+        self.snapshot_duration = Histogram(
+            "guber_tpu_snapshot_duration_seconds",
+            "Wall time of one arena snapshot (export + serialize + write).",
+            registry=self.registry,
+        )
+        self.snapshot_size = Gauge(
+            "guber_tpu_snapshot_bytes",
+            "Size of the last written snapshot in bytes.",
+            registry=self.registry,
+        )
+        self.snapshot_total = Counter(
+            "guber_tpu_snapshots_total",
+            "Snapshot attempts.",
+            ["status"],  # success | failed
+            registry=self.registry,
+        )
+        self.restore_age = Gauge(
+            "guber_tpu_restore_age_seconds",
+            "Age of the snapshot restored at boot (0 when cold-started).",
+            registry=self.registry,
+        )
+        self.migrated_keys = Counter(
+            "guber_tpu_migrated_keys_total",
+            "Bucket rows shipped or imported by live key migration.",
+            ["direction"],  # out | in
+            registry=self.registry,
+        )
+        self.migration_skipped_stale = Counter(
+            "guber_tpu_migration_skipped_stale_total",
+            "Incoming migrated rows dropped because a fresher local entry "
+            "existed.",
+            registry=self.registry,
+        )
 
     def add_scrape_hook(self, fn) -> None:
         """Register a callable run before every expose() — the analog of the
@@ -118,21 +159,45 @@ class Metrics:
         self._scrape_hooks.append(fn)
 
     def watch_engine(self, engine) -> None:
-        """Export the engine's cache stats at scrape time: cache_size gauge
-        plus hit/miss counters advanced by delta since the last scrape."""
+        """Export the engine's cache stats at scrape time through ONE
+        coherent accessor (engine.cache_stats): the cache_size gauge,
+        hit/miss counters advanced by delta since the last scrape, and the
+        free/live/expired slot occupancy gauges all come from the same
+        read, so a scrape never mixes counters from different moments."""
         last = {"hit": 0, "miss": 0}
 
         def refresh():
-            self.cache_size.set(engine.cache_size)
-            hits, misses = engine.cache_hits, engine.cache_misses
-            if hits > last["hit"]:
-                self.cache_access_count.labels(type="hit").inc(hits - last["hit"])
-                last["hit"] = hits
-            if misses > last["miss"]:
-                self.cache_access_count.labels(type="miss").inc(misses - last["miss"])
-                last["miss"] = misses
+            st = engine.cache_stats()
+            self.cache_size.set(st["size"])
+            for state in ("free", "live", "expired"):
+                self.cache_slots.labels(state=state).set(st[state])
+            if st["hits"] > last["hit"]:
+                self.cache_access_count.labels(type="hit").inc(
+                    st["hits"] - last["hit"])
+                last["hit"] = st["hits"]
+            if st["misses"] > last["miss"]:
+                self.cache_access_count.labels(type="miss").inc(
+                    st["misses"] - last["miss"])
+                last["miss"] = st["misses"]
 
         self.add_scrape_hook(refresh)
+
+    def observe_snapshot(self, seconds: float, size_bytes: int,
+                         ok: bool) -> None:
+        self.snapshot_total.labels(
+            status="success" if ok else "failed").inc()
+        if ok:
+            self.snapshot_duration.observe(seconds)
+            self.snapshot_size.set(size_bytes)
+
+    def observe_migration(self, moved: int = 0, imported: int = 0,
+                          skipped_stale: int = 0) -> None:
+        if moved:
+            self.migrated_keys.labels(direction="out").inc(moved)
+        if imported:
+            self.migrated_keys.labels(direction="in").inc(imported)
+        if skipped_stale:
+            self.migration_skipped_stale.inc(skipped_stale)
 
     def expose(self) -> bytes:
         for fn in self._scrape_hooks:

@@ -107,6 +107,26 @@ class _PeersServicer:
         return pb.GetPeerRateLimitsResp(
             rate_limits=[pb.resp_to_pb(r) for r in resps]).SerializeToString()
 
+    async def TransferBuckets(self, data: bytes, context):
+        """Bucket-migration import lane (state/migrate.py): bytes in
+        (versioned JSON rows), ack bytes out."""
+        from gubernator_tpu.state.migrate import MigrationError
+        start = time.monotonic()
+        m = self.instance.metrics
+        try:
+            ack = await self.instance.transfer_buckets(data)
+        except MigrationError as e:
+            m.observe_rpc("/pb.gubernator.PeersV1/TransferBuckets", start,
+                          ok=False)
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except Exception as e:
+            m.observe_rpc("/pb.gubernator.PeersV1/TransferBuckets", start,
+                          ok=False)
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        m.observe_rpc("/pb.gubernator.PeersV1/TransferBuckets", start,
+                      ok=True)
+        return ack
+
     async def RegisterGlobals(self, request, context):
         start = time.monotonic()
         m = self.instance.metrics

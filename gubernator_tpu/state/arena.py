@@ -160,4 +160,71 @@ class SlotTable:
     def remove(self, key: str) -> None:
         ent = self._entries.pop(key, None)
         if ent is not None:
+            # the entry may still sit in _uncommitted (allocated this
+            # window): commit_window would then mutate a freed entry, and a
+            # reuse of the slot could have its init flag cleared by the OLD
+            # entry's commit — drop it from the pending list with the entry
+            self._uncommitted = [e for e in self._uncommitted if e is not ent]
             self._free.append(ent[0])
+
+    # ------------------------------------------------------- state lifecycle
+
+    def stats(self, now: int) -> dict:
+        """Occupancy by the host-side expiry estimate: free slots, live and
+        expired resident entries (state/snapshot + cache_stats surface)."""
+        live = sum(1 for e in self._entries.values() if e[1] >= now)
+        return {
+            "free": self.capacity - len(self._entries),
+            "live": live,
+            "expired": len(self._entries) - live,
+        }
+
+    def export_entries(self):
+        """(key, slot, expire_estimate) in LRU order (oldest first).
+
+        Entries still pending device init are skipped: their device rows
+        were never written, so a snapshot of them would resurrect whatever
+        the slot's previous tenant left behind."""
+        return [(k, e[0], e[1]) for k, e in self._entries.items() if not e[2]]
+
+    def restore_entries(self, entries) -> None:
+        """Rebuild the table from export_entries() output (oldest first).
+        Replaces all current state; restored entries are committed (their
+        device rows are restored by the same snapshot)."""
+        self._entries = OrderedDict()
+        used = set()
+        for key, slot, expire in entries:
+            if not (0 <= slot < self.capacity) or slot in used:
+                raise ValueError(f"invalid slot {slot} for key {key!r}")
+            used.add(slot)
+            self._entries[key] = [int(slot), int(expire), False, 0]
+        self._free = [s for s in range(self.capacity - 1, -1, -1)
+                      if s not in used]
+        self._expiry_heap = [(e[1], k) for k, e in self._entries.items()]
+        heapq.heapify(self._expiry_heap)
+        self._uncommitted = []
+
+    def upsert(self, key: str, now: int, expire_estimate: int) -> int:
+        """Slot for `key`, allocating if absent, with the expiry estimate
+        set exactly (migration import: the caller writes the device row in
+        the same quiesced section, so the entry is born committed — no
+        pending init that a later window commit could clear)."""
+        ent = self._entries.get(key)
+        if ent is not None:
+            if ent[1] != expire_estimate:
+                ent[1] = expire_estimate
+                heapq.heappush(self._expiry_heap, (expire_estimate, key))
+            self._entries.move_to_end(key)
+            return ent[0]
+        slot = self._free.pop() if self._free else self._reclaim(now)
+        self._entries[key] = [slot, expire_estimate, False, self._seq]
+        heapq.heappush(self._expiry_heap, (expire_estimate, key))
+        return slot
+
+    def is_pending(self, key: str) -> bool:
+        """True while the key's slot awaits its initializing dispatch."""
+        ent = self._entries.get(key)
+        return bool(ent is not None and ent[2])
+
+    def keys(self):
+        return list(self._entries.keys())

@@ -1,0 +1,119 @@
+"""Live key migration on a peer-ring change.
+
+When the cluster membership changes, the consistent-hash ring re-homes a
+fraction of the key space (~1/N of keys on an N-node grow — the ring's
+minimal-movement property).  The reference simply lets re-homed counters
+restart from zero on their new owner; here the OLD owner ships each moved
+key's live device bucket row to the NEW owner over the TransferBuckets peer
+lane, so `remaining`/`reset_time` survive the ring change.
+
+Split of responsibilities:
+
+  ownership_diff       pure: which keys move where, given old/new host sets
+  encode/decode_rows   the TransferBuckets wire payload (versioned JSON —
+                       control-plane volume, not the serving path)
+  Instance.migrate_keys     source side: diff, export, ship, drop local
+  Instance.transfer_buckets dest side: import with init-flag semantics that
+                            never clobber a fresher local entry
+                            (engine.import_rows / import_global_rows)
+
+GLOBAL keys re-REGISTER on the new owner (config + replicated state row
+move) but are NOT dropped at the source: every node keeps a serving replica
+of GLOBAL keys; only ownership (who aggregates async hits) moves.
+
+Requires the Python SlotTable routing backend (EngineConfig
+use_native=False): the native C++ router keeps 64-bit fingerprints, not key
+strings, and a fingerprint cannot be re-hashed onto the ring.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+from gubernator_tpu.parallel.router import ConsistentHashRing
+
+log = logging.getLogger("gubernator.migrate")
+
+WIRE_VERSION = 1
+
+_ROW_FIELDS = ("key", "limit", "duration", "remaining", "tstamp", "expire",
+               "algo")
+_GROW_FIELDS = _ROW_FIELDS + ("cfg_limit", "cfg_duration", "cfg_algo")
+
+
+class MigrationError(Exception):
+    """Malformed transfer payload or ack."""
+
+
+def _ring_of(hosts: Iterable[str]) -> ConsistentHashRing:
+    ring: ConsistentHashRing[str] = ConsistentHashRing()
+    for h in hosts:
+        ring.add(h, h)
+    return ring
+
+
+def ownership_diff(keys: Sequence[str], old_hosts: Iterable[str],
+                   new_hosts: Iterable[str]) -> Dict[str, List[str]]:
+    """Which of `keys` change owner between the two memberships?
+    Returns {new_owner_host: [keys]} — only re-homed keys appear, so on an
+    N -> N+1 grow this is ~1/(N+1) of the key space, per the ring's
+    minimal-movement property."""
+    old = _ring_of(old_hosts)
+    new = _ring_of(new_hosts)
+    moved: Dict[str, List[str]] = {}
+    for k in keys:
+        o = old.get(k)
+        n = new.get(k)
+        if o != n:
+            moved.setdefault(n, []).append(k)
+    return moved
+
+
+# -------------------------------------------------------------- wire codec
+
+
+def encode_rows(regular: Sequence[dict], global_: Sequence[dict]) -> bytes:
+    return json.dumps({
+        "v": WIRE_VERSION,
+        "regular": [[r[f] for f in _ROW_FIELDS] for r in regular],
+        "global": [[r[f] for f in _GROW_FIELDS] for r in global_],
+    }).encode("utf-8")
+
+
+def decode_rows(data: bytes) -> Tuple[List[dict], List[dict]]:
+    try:
+        msg = json.loads(data.decode("utf-8"))
+        if msg["v"] != WIRE_VERSION:
+            raise MigrationError(
+                f"unsupported transfer wire version {msg['v']}")
+        regular = [dict(zip(_ROW_FIELDS, r)) for r in msg["regular"]]
+        global_ = [dict(zip(_GROW_FIELDS, r)) for r in msg["global"]]
+    except MigrationError:
+        raise
+    except Exception as e:
+        raise MigrationError(f"malformed transfer payload: {e}") from None
+    for rows, fields in ((regular, _ROW_FIELDS), (global_, _GROW_FIELDS)):
+        for r in rows:
+            if not isinstance(r["key"], str) or any(
+                    not isinstance(r[f], int) for f in fields[1:]):
+                raise MigrationError("malformed transfer row")
+    return regular, global_
+
+
+def encode_ack(imported: int, skipped: int, gimported: int,
+               gskipped: int) -> bytes:
+    return json.dumps({
+        "v": WIRE_VERSION, "imported": imported, "skipped_stale": skipped,
+        "gimported": gimported, "gskipped_stale": gskipped,
+    }).encode("utf-8")
+
+
+def decode_ack(data: bytes) -> dict:
+    try:
+        msg = json.loads(data.decode("utf-8"))
+        return {k: int(msg[k]) for k in
+                ("imported", "skipped_stale", "gimported", "gskipped_stale")}
+    except Exception as e:
+        raise MigrationError(f"malformed transfer ack: {e}") from None

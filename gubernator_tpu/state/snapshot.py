@@ -1,0 +1,413 @@
+"""Versioned, checksummed snapshots of the device arena + key maps.
+
+The rate-limiter analogue of the reference's Loader/PersistentStore
+(persistent_store.go): a daemon restart must not zero every counter.  A
+snapshot captures
+
+  * the SoA arena planes (regular [S_local, C] + GLOBAL [G] + gcfg) as a
+    device->host export,
+  * the key->slot maps (Python SlotTable keys, or the native router's
+    fingerprint table — entry index == device slot, so fingerprints alone
+    keep the restored map coherent with the restored planes),
+  * metadata: geometry, creation time, layout, compact-soundness.
+
+Two on-disk time layouts, chosen per snapshot:
+
+  "int64"     tstamp/expire stored as absolute ms-epoch int64 — always valid.
+  "compact32" tstamp/expire stored as int32 deltas REBASED against the
+              snapshot timestamp, and limit/duration/remaining truncated to
+              int32 — half the plane bytes.  The rebase runs through
+              ops/pallas_kernel's _pair_rebase/_pair_reabs (the fused
+              megakernel's own helpers), so the snapshot codec CANNOT drift
+              from the serving path's int32 time math.  Chosen only when
+              every live value round-trips exactly (engine export checks),
+              so restore is bit-identical to the int64 layout either way.
+
+Restore rebases times back to absolute by default (downtime counts against
+TTLs, matching an uninterrupted oracle).  `rebase_to` instead shifts every
+timestamp by (rebase_to - snapshot now) — for restoring into a different
+clock domain while preserving each bucket's REMAINING lifetime.
+
+File format (version 1):
+
+  8 bytes   magic b"GUBSNAP\\x01"
+  4 bytes   format version (u32 LE)
+  4 bytes   crc32 of the payload (u32 LE)
+  payload   npz archive (numpy savez) holding the meta JSON + every array
+
+A truncated or bit-flipped file fails the crc (or the parse) and raises
+SnapshotError — restore_engine turns that into a logged cold start, never a
+crash.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import os
+import struct
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+log = logging.getLogger("gubernator.snapshot")
+
+MAGIC = b"GUBSNAP\x01"
+VERSION = 1
+
+# int32 sentinel marking a never-initialized slot's times in the compact32
+# layout (expire == 0 on device).  Outside the +/-_REBASE_LIM clip range, so
+# it can never collide with a real rebased delta.
+DEAD_REL = -(2 ** 31)
+
+_REG_PLANES = ("limit", "duration", "remaining", "tstamp", "expire", "algo")
+_CFG_PLANES = ("limit", "duration", "algo")
+
+
+class SnapshotError(Exception):
+    """Unusable snapshot: bad magic/version/checksum, truncated payload, or
+    a geometry mismatch with the restoring engine."""
+
+
+@dataclass
+class ArenaSnapshot:
+    """Host-side image of one engine's state (this process's shard blocks).
+
+    planes/gplanes/gcfg hold int64/int32 numpy arrays in the INT64 layout —
+    the compact32 encoding exists only on the wire (serialize/deserialize),
+    so every in-memory consumer sees one canonical form.
+    """
+
+    now: int                      # ms epoch at export
+    layout: str                   # requested wire layout: int64 | compact32
+    num_shards: int
+    capacity_per_shard: int
+    global_capacity: int
+    num_local_shards: int
+    local_shard_offset: int
+    compact_sound: bool
+    backend: str                  # "python" | "native"
+    planes: Dict[str, np.ndarray]     # regular arena [S_local, C]
+    gplanes: Dict[str, np.ndarray]    # GLOBAL arena [G]
+    gcfg: Dict[str, np.ndarray]       # GLOBAL config [G]
+    # python backend: per local shard, (keys, slot i32[n], expire i64[n])
+    tables: List[tuple] = field(default_factory=list)
+    # native backend: per local shard, (fp u64[n], slot i32[n], expire i64[n])
+    native_tables: List[tuple] = field(default_factory=list)
+    gtable: tuple = ()            # (keys, slot, expire) of the GLOBAL table
+    gpending: List[str] = field(default_factory=list)
+
+    def total_keys(self) -> int:
+        reg = (sum(len(t[1]) for t in self.native_tables)
+               if self.backend == "native"
+               else sum(len(t[1]) for t in self.tables))
+        return reg + (len(self.gtable[1]) if self.gtable else 0)
+
+
+# ---------------------------------------------------------------- time codec
+
+
+def _pair_codec():
+    """The fused megakernel's (lo, hi) int32 rebase helpers, jitted once
+    over flat arrays.  Importing lazily keeps `state` free of jax at module
+    import (host-only tools load this module too)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from gubernator_tpu.ops import pallas_kernel as pk
+
+    @jax.jit
+    def enc(t, now):
+        pair = lax.bitcast_convert_type(t, jnp.int32)       # [N, 2]
+        npair = lax.bitcast_convert_type(now, jnp.int32)    # [2]
+        return pk._pair_rebase(pair[:, 0], pair[:, 1], npair[0], npair[1])
+
+    @jax.jit
+    def dec(rel, now):
+        npair = lax.bitcast_convert_type(now, jnp.int32)
+        lo, hi = pk._pair_reabs(rel, npair[0], npair[1])
+        return lax.bitcast_convert_type(
+            jnp.stack([lo, hi], axis=-1), jnp.int64)
+
+    return enc, dec
+
+
+_codec = None
+
+
+def _codec_fns():
+    global _codec
+    if _codec is None:
+        _codec = _pair_codec()
+    return _codec
+
+
+def rebase_encode(times: np.ndarray, dead: np.ndarray, now: int) -> np.ndarray:
+    """int64 ms-epoch -> int32 delta vs `now` via _pair_rebase; dead slots
+    (expire == 0 on device) carry the DEAD_REL sentinel instead."""
+    enc, _ = _codec_fns()
+    rel = np.asarray(enc(np.ascontiguousarray(times, np.int64).reshape(-1),
+                         np.int64(now)))
+    rel = rel.reshape(times.shape).copy()
+    rel[dead] = DEAD_REL
+    return rel
+
+
+def rebase_decode(rel: np.ndarray, now: int) -> np.ndarray:
+    """Inverse of rebase_encode: int32 delta -> absolute int64 (sentinel
+    slots decode back to 0)."""
+    _, dec = _codec_fns()
+    out = np.asarray(dec(np.ascontiguousarray(rel, np.int32).reshape(-1),
+                         np.int64(now)))
+    out = out.reshape(rel.shape).copy()
+    out[rel == DEAD_REL] = 0
+    return out
+
+
+def compact_encodable(snap: "ArenaSnapshot") -> bool:
+    """May this snapshot travel in the compact32 layout losslessly?  Times
+    of live slots must sit within the rebase clip range of snap.now, and
+    every value plane must fit int32 (the same caps the compact serving
+    wire enforces — engine._compact_sound implies them for live rows, but a
+    pre-soundness-trip arena may hold wider values, so check the data)."""
+    lim = (2 ** 31) - 16  # pallas_kernel._REBASE_LIM
+    i32 = 2 ** 31
+
+    def _planes_ok(planes):
+        dead = planes["expire"] == 0
+        for name in ("limit", "duration", "remaining"):
+            a = planes[name]
+            if a.size and (a.min() < -i32 or a.max() >= i32):
+                return False
+        for name in ("tstamp", "expire"):
+            d = planes[name][~dead] - snap.now
+            if d.size and (d.min() < -lim or d.max() > lim):
+                return False
+        return True
+
+    return _planes_ok(snap.planes) and _planes_ok(snap.gplanes) and all(
+        not (a.size and (a.min() < -i32 or a.max() >= i32))
+        for n, a in snap.gcfg.items() if n != "algo")
+
+
+# -------------------------------------------------------------- wire format
+
+
+def _pack_keys(keys: List[str]):
+    blob = b"".join(k.encode("utf-8") for k in keys)
+    ends = np.cumsum([len(k.encode("utf-8")) for k in keys]).astype(np.int64) \
+        if keys else np.empty(0, np.int64)
+    return np.frombuffer(blob, np.uint8).copy(), ends
+
+
+def _unpack_keys(blob: np.ndarray, ends: np.ndarray) -> List[str]:
+    raw = blob.tobytes()
+    keys, start = [], 0
+    for end in ends.tolist():
+        keys.append(raw[start:end].decode("utf-8"))
+        start = end
+    return keys
+
+
+def dumps(snap: ArenaSnapshot) -> bytes:
+    """Serialize with the layout the snapshot asks for, silently widening
+    to int64 when compact32 cannot represent the data exactly."""
+    layout = snap.layout
+    if layout == "compact32" and not compact_encodable(snap):
+        log.warning("snapshot data exceeds the compact32 range; "
+                    "writing the int64 layout instead")
+        layout = "int64"
+
+    arrays: Dict[str, np.ndarray] = {}
+
+    def put_planes(prefix: str, planes: Dict[str, np.ndarray]):
+        dead = planes["expire"] == 0
+        for name, a in planes.items():
+            if layout == "compact32" and name in ("tstamp", "expire"):
+                arrays[f"{prefix}{name}"] = rebase_encode(a, dead, snap.now)
+            elif layout == "compact32" and name in ("limit", "duration",
+                                                    "remaining"):
+                arrays[f"{prefix}{name}"] = a.astype(np.int32)
+            else:
+                arrays[f"{prefix}{name}"] = a
+
+    put_planes("reg_", snap.planes)
+    put_planes("g_", snap.gplanes)
+    for name, a in snap.gcfg.items():
+        arrays[f"gcfg_{name}"] = a
+
+    for i, (keys, slots, expires) in enumerate(snap.tables):
+        blob, ends = _pack_keys(keys)
+        arrays[f"t{i}_keys"] = blob
+        arrays[f"t{i}_ends"] = ends
+        arrays[f"t{i}_slot"] = np.asarray(slots, np.int32)
+        arrays[f"t{i}_expire"] = np.asarray(expires, np.int64)
+    for i, (fp, slots, expires) in enumerate(snap.native_tables):
+        arrays[f"n{i}_fp"] = np.asarray(fp, np.uint64)
+        arrays[f"n{i}_slot"] = np.asarray(slots, np.int32)
+        arrays[f"n{i}_expire"] = np.asarray(expires, np.int64)
+    if snap.gtable:
+        keys, slots, expires = snap.gtable
+        blob, ends = _pack_keys(keys)
+        arrays["gt_keys"] = blob
+        arrays["gt_ends"] = ends
+        arrays["gt_slot"] = np.asarray(slots, np.int32)
+        arrays["gt_expire"] = np.asarray(expires, np.int64)
+
+    meta = {
+        "now": snap.now,
+        "layout": layout,
+        "num_shards": snap.num_shards,
+        "capacity_per_shard": snap.capacity_per_shard,
+        "global_capacity": snap.global_capacity,
+        "num_local_shards": snap.num_local_shards,
+        "local_shard_offset": snap.local_shard_offset,
+        "compact_sound": snap.compact_sound,
+        "backend": snap.backend,
+        "gpending": list(snap.gpending),
+    }
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode("utf-8"), np.uint8).copy()
+
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    payload = buf.getvalue()
+    head = MAGIC + struct.pack("<II", VERSION, zlib.crc32(payload))
+    return head + payload
+
+
+def loads(data: bytes) -> ArenaSnapshot:
+    """Parse + verify a snapshot blob; raises SnapshotError on anything
+    short of a bit-exact, version-compatible payload."""
+    if len(data) < len(MAGIC) + 8 or data[:len(MAGIC)] != MAGIC:
+        raise SnapshotError("not a gubernator snapshot (bad magic)")
+    version, crc = struct.unpack_from("<II", data, len(MAGIC))
+    if version != VERSION:
+        raise SnapshotError(f"unsupported snapshot version {version}")
+    payload = data[len(MAGIC) + 8:]
+    if zlib.crc32(payload) != crc:
+        raise SnapshotError("snapshot checksum mismatch (truncated or "
+                            "corrupted file)")
+    try:
+        with np.load(io.BytesIO(payload)) as z:
+            arrays = {k: z[k] for k in z.files}
+        meta = json.loads(arrays.pop("__meta__").tobytes().decode("utf-8"))
+    except SnapshotError:
+        raise
+    except Exception as e:
+        raise SnapshotError(f"malformed snapshot payload: {e}") from None
+
+    layout = meta["layout"]
+    now = int(meta["now"])
+
+    def get_planes(prefix: str) -> Dict[str, np.ndarray]:
+        planes = {}
+        for name in _REG_PLANES:
+            a = arrays[f"{prefix}{name}"]
+            if layout == "compact32" and name in ("tstamp", "expire"):
+                a = rebase_decode(a, now)
+            elif name != "algo":
+                a = a.astype(np.int64)
+            planes[name] = a
+        return planes
+
+    try:
+        planes = get_planes("reg_")
+        gplanes = get_planes("g_")
+        gcfg = {name: arrays[f"gcfg_{name}"] for name in _CFG_PLANES}
+        tables, native_tables = [], []
+        for i in range(int(meta["num_local_shards"])):
+            if f"t{i}_slot" in arrays:
+                tables.append((
+                    _unpack_keys(arrays[f"t{i}_keys"], arrays[f"t{i}_ends"]),
+                    arrays[f"t{i}_slot"], arrays[f"t{i}_expire"]))
+            elif f"n{i}_slot" in arrays:
+                native_tables.append((
+                    arrays[f"n{i}_fp"], arrays[f"n{i}_slot"],
+                    arrays[f"n{i}_expire"]))
+        gtable = ()
+        if "gt_slot" in arrays:
+            gtable = (_unpack_keys(arrays["gt_keys"], arrays["gt_ends"]),
+                      arrays["gt_slot"], arrays["gt_expire"])
+    except KeyError as e:
+        raise SnapshotError(f"snapshot payload missing array {e}") from None
+
+    return ArenaSnapshot(
+        now=now, layout=layout,
+        num_shards=int(meta["num_shards"]),
+        capacity_per_shard=int(meta["capacity_per_shard"]),
+        global_capacity=int(meta["global_capacity"]),
+        num_local_shards=int(meta["num_local_shards"]),
+        local_shard_offset=int(meta["local_shard_offset"]),
+        compact_sound=bool(meta["compact_sound"]),
+        backend=meta["backend"],
+        planes=planes, gplanes=gplanes, gcfg=gcfg,
+        tables=tables, native_tables=native_tables, gtable=gtable,
+        gpending=list(meta.get("gpending", ())),
+    )
+
+
+# ---------------------------------------------------------------- file I/O
+
+
+def save(snap: ArenaSnapshot, path: str) -> int:
+    """Atomic write (tmp + rename): a crash mid-write leaves the previous
+    snapshot intact.  Returns the byte size written."""
+    data = dumps(snap)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return len(data)
+
+
+def load(path: str) -> ArenaSnapshot:
+    with open(path, "rb") as f:
+        return loads(f.read())
+
+
+def snapshot_path(directory: str, local_shard_offset: int = 0,
+                  multiprocess: bool = False) -> str:
+    """One file per process: mesh processes share GUBER_SNAPSHOT_DIR, so
+    each writes its own local shard blocks keyed by shard offset."""
+    name = (f"arena-r{local_shard_offset}.snap" if multiprocess
+            else "arena.snap")
+    return os.path.join(directory, name)
+
+
+def restore_engine(engine, path: str, rebase_to: Optional[int] = None,
+                   metrics=None) -> Optional[ArenaSnapshot]:
+    """Daemon-boot restore: load + import, degrading to a cold arena (with
+    a warning) on ANY failure — a corrupt snapshot must never block a boot.
+    Returns the snapshot on success, None on cold start."""
+    try:
+        snap = load(path)
+    except FileNotFoundError:
+        log.info("no snapshot at %s; starting cold", path)
+        return None
+    except SnapshotError as e:
+        log.warning("snapshot %s unusable (%s); starting cold", path, e)
+        return None
+    try:
+        engine.import_state(snap, rebase_to=rebase_to)
+    except Exception as e:
+        log.warning("snapshot %s failed to import (%s); starting cold",
+                    path, e)
+        return None
+    if metrics is not None:
+        from gubernator_tpu.api.types import millisecond_now
+        metrics.restore_age.set(max(0.0, (millisecond_now() - snap.now)
+                                    / 1000.0))
+    log.info("restored %d keys from %s (age %.1fs)", snap.total_keys(), path,
+             max(0, _age_ms(snap)) / 1000.0)
+    return snap
+
+
+def _age_ms(snap: ArenaSnapshot) -> int:
+    from gubernator_tpu.api.types import millisecond_now
+    return millisecond_now() - snap.now

@@ -168,3 +168,44 @@ def test_heap_bounded_under_churn_at_scale():
     # normal pack is a few ms, the pre-fix rebuild at this size is an
     # order of magnitude past even this.
     assert max_call < 0.5, f"staging stalled {max_call * 1e3:.0f}ms"
+
+
+def test_slottable_remove_drops_pending_entry():
+    """remove() must drop the entry from the pending-init list too: a
+    commit_window after remove would otherwise mutate the FREED entry, and
+    a new key recycling the slot in the same window could have its init
+    flag cleared by the old entry's commit — the recycled slot would then
+    serve the previous tenant's stale device state as live."""
+    t = SlotTable(2)
+    t.begin_window()
+    s0, is_init = t.lookup("gone", T0, 1000)
+    assert is_init
+    t.remove("gone")
+    assert "gone" not in t
+    # the freed slot is reallocated to a NEW key within the same window
+    s1, is_init1 = t.lookup("fresh", T0, 1000)
+    assert is_init1
+    t.commit_window()
+    # the commit may only touch live entries: "fresh" is committed...
+    assert not t.is_pending("fresh")
+    # ...and a later window re-looking it up must NOT re-init
+    t.begin_window()
+    slot, is_init2 = t.lookup("fresh", T0 + 1, 1000)
+    assert slot == s1 and not is_init2
+    t.commit_window()
+
+
+def test_slottable_remove_then_commit_does_not_resurrect():
+    """The freed entry object must not be committed: if remove() leaves it
+    in _uncommitted, commit_window() flips its pending flag even though the
+    key is gone; a re-insert of the SAME key after remove must still carry
+    is_init=True (the device row is a dead tenant)."""
+    t = SlotTable(4)
+    t.begin_window()
+    t.lookup("k", T0, 1000)
+    t.remove("k")
+    t.commit_window()
+    t.begin_window()
+    _, is_init = t.lookup("k", T0 + 1, 1000)
+    assert is_init, "re-inserted key lost its init flag after remove"
+    t.commit_window()
