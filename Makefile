@@ -1,7 +1,7 @@
 # Dev targets (the reference Makefile:1-15 has only release/docker; we add
 # the working set).
 
-.PHONY: test test-core test-pallas test-mesh-fused test-snapshot proto bench docker lint cluster
+.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos proto bench docker lint cluster
 
 test:
 	python -m pytest tests/ -x -q
@@ -26,6 +26,12 @@ test-mesh-fused:
 # too); this target runs just the slice.
 test-snapshot:
 	python -m pytest tests/ -x -q -m "snapshot and not slow"
+
+# the QoS slice: admission/shedding, AIMD window adaptation, tenant-fair
+# slotting, peer circuit breaking — all CPU-only with injectable clocks.
+# Part of tier-1 (`test-core` picks it up too); this target runs just it.
+test-qos:
+	python -m pytest tests/ -x -q -m "qos and not slow"
 
 proto:
 	cd gubernator_tpu/api/proto && protoc --python_out=. gubernator.proto peers.proto

@@ -42,9 +42,25 @@ def build_app(instance: Instance) -> web.Application:
             except json_format.ParseError as e:
                 return web.json_response({"error": str(e), "code": 3},
                                          status=400)
+            # QoS deadline propagation: X-Guber-Timeout-Ms carries the
+            # client's remaining budget (grpc-gateway's grpc-timeout
+            # analog); admission sheds what cannot be served in time
+            deadline = None
+            if instance.qos is not None:
+                timeout_ms = request.headers.get("X-Guber-Timeout-Ms")
+                timeout_s = None
+                if timeout_ms:
+                    try:
+                        timeout_s = float(timeout_ms) / 1000.0
+                    except ValueError:
+                        return web.json_response(
+                            {"error": "invalid X-Guber-Timeout-Ms header",
+                             "code": 3}, status=400)
+                deadline = instance.qos.deadline_from_timeout(timeout_s)
             try:
                 resps = await instance.get_rate_limits(
-                    [pb.req_from_pb(r) for r in msg.requests])
+                    [pb.req_from_pb(r) for r in msg.requests],
+                    deadline=deadline)
             except BatchTooLargeError as e:
                 return web.json_response({"error": str(e), "code": 11},
                                          status=400)

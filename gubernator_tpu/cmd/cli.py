@@ -43,15 +43,30 @@ async def _load(address: str, count: int, concurrency: int) -> None:
         for _ in range(count)
     ]
     sem = asyncio.Semaphore(concurrency)
+    # distinguish real OVER_LIMITs from QoS load shedding (the daemon
+    # answers sheds in-band with metadata.shed_reason, qos/admission.py)
+    stats = {"served": 0, "over_limit": 0}
 
     async def hit(req: RateLimitReq) -> None:
         async with sem:
             resps = await client.get_rate_limits([req], timeout=0.5)
-            if resps[0].status == Status.OVER_LIMIT:
-                print(resps[0])
+            r = resps[0]
+            reason = (r.metadata or {}).get("shed_reason")
+            if reason is not None:
+                stats[f"shed:{reason}"] = stats.get(f"shed:{reason}", 0) + 1
+            elif r.status == Status.OVER_LIMIT:
+                stats["over_limit"] += 1
+                print(r)
+            else:
+                stats["served"] += 1
 
+    rounds = 0
     while True:
         await asyncio.gather(*(hit(r) for r in reqs))
+        rounds += 1
+        if rounds % 10 == 0:
+            print("totals:", " ".join(
+                f"{k}={v}" for k, v in sorted(stats.items())))
 
 
 def _http_base(address: str) -> str:

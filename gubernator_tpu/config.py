@@ -78,6 +78,60 @@ class EngineConfig:
 
 
 @dataclass
+class QoSConfig:
+    """QoS / overload-control knobs (gubernator_tpu/qos/): admission
+    control, AIMD congestion window, per-tenant fair slotting, and the
+    peer-lane resilience layer.  No reference analog — the reference
+    queues unboundedly and surfaces peer failures as raw gRPC errors."""
+
+    enabled: bool = True
+    # ---- admission (qos/admission.py)
+    # Bounded pending queue, in decisions; 0 disables the bound.  Sized a
+    # few drain cycles deep: deeper only adds latency, never throughput.
+    max_pending: int = 8192
+    # Implicit per-request deadline (seconds) when the client sends none;
+    # 0 = requests without a deadline never deadline-shed.
+    default_deadline: float = 0.0
+    # ---- congestion window (qos/congestion.py)
+    min_window: int = 64
+    max_window: int = 8192
+    # Drain-latency target the AIMD tracks (seconds).  Above it: cwnd *=
+    # aimd_decrease (once per cooldown); below: cwnd += aimd_increase.
+    target_drain_latency: float = 0.1
+    aimd_increase: float = 64.0
+    aimd_decrease: float = 0.5
+    latency_ewma_alpha: float = 0.3
+    # ---- fair slotting (qos/fairness.py)
+    fair_slotting: bool = True
+    # ---- peer lane (qos/breaker.py + net/peers.py)
+    peer_retries: int = 2          # retries after the first attempt
+    retry_base: float = 0.025      # seconds; doubles per attempt, jittered
+    retry_cap: float = 0.25
+    breaker_fail_threshold: int = 5
+    breaker_open_duration: float = 2.0
+    breaker_half_open_probes: int = 1
+    # While a peer's breaker is open: True = fail open (answer from the
+    # local engine, non-authoritative, flagged in metadata); False = fail
+    # closed (in-band shed with reason breaker_open).
+    fail_open: bool = True
+
+    def validate(self) -> None:
+        if self.max_pending < 0:
+            raise ValueError("QoS.max_pending must be >= 0")
+        if self.min_window < 1 or self.max_window < self.min_window:
+            raise ValueError(
+                "QoS window bounds need 1 <= min_window <= max_window")
+        if not (0.0 < self.aimd_decrease < 1.0):
+            raise ValueError("QoS.aimd_decrease must be in (0, 1)")
+        if not (0.0 < self.latency_ewma_alpha <= 1.0):
+            raise ValueError("QoS.latency_ewma_alpha must be in (0, 1]")
+        if self.target_drain_latency <= 0:
+            raise ValueError("QoS.target_drain_latency must be > 0")
+        if self.peer_retries < 0:
+            raise ValueError("QoS.peer_retries must be >= 0")
+
+
+@dataclass
 class PeerInfo:
     # reference etcd.go:29-32
     address: str = ""
@@ -93,6 +147,7 @@ class Config:
     grpc_address: str = ""
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
+    qos: QoSConfig = field(default_factory=QoSConfig)
     # advertise address used for self-identification in the peer ring
     advertise_address: str = ""
 
@@ -152,6 +207,7 @@ class DaemonConfig:
 
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
+    qos: QoSConfig = field(default_factory=QoSConfig)
 
     @property
     def k8s_enabled(self) -> bool:
@@ -173,6 +229,15 @@ def env_int(name: str, default: int, minimum: int = 1) -> int:
     engine's GUBER_PIPELINE_KMAX and the pipeline's GUBER_FETCH_WORKERS."""
     try:
         return max(minimum, int(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float, minimum: float = 0.0) -> float:
+    """Float GUBER_* knob with a floor; malformed values fall back to the
+    default (perf tunables must never crash a boot)."""
+    try:
+        return max(minimum, float(os.environ.get(name, default)))
     except ValueError:
         return default
 
@@ -301,5 +366,38 @@ def config_from_env(env_file: Optional[str] = None) -> DaemonConfig:
         e.exact_keys = _env("GUBER_EXACT_KEYS") == "1"
     if _env("GUBER_REPLAY_CAP"):
         e.replay_cap = int(_env("GUBER_REPLAY_CAP"))
+
+    # QoS / overload control (gubernator_tpu/qos/; full list example.conf)
+    q = c.qos
+    q.enabled = env_bool("GUBER_QOS_ENABLED", q.enabled)
+    q.max_pending = env_int("GUBER_QOS_MAX_PENDING", q.max_pending,
+                            minimum=0)
+    q.default_deadline = env_float("GUBER_QOS_DEFAULT_DEADLINE_MS",
+                                   q.default_deadline * 1000.0) / 1000.0
+    q.min_window = env_int("GUBER_QOS_MIN_WINDOW", q.min_window)
+    q.max_window = env_int("GUBER_QOS_MAX_WINDOW", q.max_window)
+    q.target_drain_latency = env_float(
+        "GUBER_QOS_TARGET_DRAIN_MS",
+        q.target_drain_latency * 1000.0, minimum=1e-3) / 1000.0
+    q.aimd_increase = env_float("GUBER_QOS_AIMD_INCREASE", q.aimd_increase,
+                                minimum=1.0)
+    if _env("GUBER_QOS_AIMD_DECREASE"):
+        q.aimd_decrease = float(_env("GUBER_QOS_AIMD_DECREASE"))
+    q.fair_slotting = env_bool("GUBER_QOS_FAIR_SLOTTING", q.fair_slotting)
+    q.peer_retries = env_int("GUBER_QOS_PEER_RETRIES", q.peer_retries,
+                             minimum=0)
+    q.retry_base = env_float("GUBER_QOS_RETRY_BASE_MS",
+                             q.retry_base * 1000.0, minimum=1.0) / 1000.0
+    q.retry_cap = env_float("GUBER_QOS_RETRY_CAP_MS",
+                            q.retry_cap * 1000.0, minimum=1.0) / 1000.0
+    q.breaker_fail_threshold = env_int("GUBER_QOS_BREAKER_FAILURES",
+                                       q.breaker_fail_threshold)
+    q.breaker_open_duration = env_float(
+        "GUBER_QOS_BREAKER_OPEN_MS",
+        q.breaker_open_duration * 1000.0, minimum=1.0) / 1000.0
+    q.breaker_half_open_probes = env_int("GUBER_QOS_BREAKER_PROBES",
+                                         q.breaker_half_open_probes)
+    q.fail_open = env_bool("GUBER_QOS_FAIL_OPEN", q.fail_open)
+    q.validate()
 
     return c

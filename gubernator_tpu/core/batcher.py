@@ -25,6 +25,7 @@ from gubernator_tpu.config import BehaviorConfig
 from gubernator_tpu.core.engine import RateLimitEngine
 from gubernator_tpu.core.interval import ArmedInterval
 from gubernator_tpu.core.pipeline import DispatchPipeline
+from gubernator_tpu.qos import interleave_by_tenant, shed_response
 
 
 class WindowBatcher:
@@ -34,10 +35,15 @@ class WindowBatcher:
         behaviors: Optional[BehaviorConfig] = None,
         metrics=None,
         lockstep_clock=None,
+        qos=None,
     ):
         self.engine = engine
         self.behaviors = behaviors or BehaviorConfig()
         self.metrics = metrics
+        # QoSManager (gubernator_tpu/qos/) or None: admission control on
+        # submit, congestion-adaptive window sizing, tenant-fair slotting.
+        # None keeps every legacy code path byte-identical.
+        self.qos = qos
         self._pending: List[tuple] = []  # (req, accumulate, future)
         self._interval: Optional[ArmedInterval] = None
         self._waiter: Optional[asyncio.Task] = None
@@ -81,7 +87,7 @@ class WindowBatcher:
                              "lockstep_clock-driven WindowBatcher")
         self.pipeline: Optional[DispatchPipeline] = DispatchPipeline(
             engine, self._executor, metrics,
-            lockstep=lockstep_clock is not None)
+            lockstep=lockstep_clock is not None, qos=qos)
         if not self.pipeline.enabled:
             self.pipeline = None
         elif self.pipeline.lockstep:
@@ -206,7 +212,14 @@ class WindowBatcher:
                 ok.append(item)
             elif not item[2].done():
                 item[2].set_exception(ValueError(err))
+        if self.qos is not None and self.qos.fair_slotting:
+            # tenant-fair slotting: the prefix cut below must not hand every
+            # lane to one hot tenant's burst (stable within tenant, so
+            # per-key order is preserved — same key => same tenant)
+            ok = interleave_by_tenant(ok, lambda t: t[0].name)
         fit = self.engine.max_window_prefix([w[0] for w in ok])
+        if self.qos is not None:
+            fit = min(fit, self._window_limit())
         window, self._pending = ok[:fit], ok[fit:]
         return window
 
@@ -274,6 +287,9 @@ class WindowBatcher:
                             raise
                         await asyncio.sleep(0.05)
             return
+        if self.qos is not None and n_reqs:
+            self.qos.congestion.observe_drain(time.monotonic() - start,
+                                             depth=len(windows))
         if self.metrics is not None and n_reqs:
             self.metrics.window_count.inc()
             self.metrics.window_occupancy.observe(n_reqs)
@@ -285,11 +301,39 @@ class WindowBatcher:
 
     # ------------------------------------------------------------- batched
 
-    async def submit(self, req: RateLimitReq, accumulate: bool = True) -> RateLimitResp:
-        """Queue into the current window; resolves when the window executes."""
+    def _window_limit(self) -> int:
+        """Flush threshold: the static batch_limit capped by the AIMD
+        congestion window (qos/congestion.py) when QoS is active."""
+        limit = self.behaviors.batch_limit
+        if self.qos is not None:
+            limit = min(limit, self.qos.congestion.effective_window())
+        return max(1, limit)
+
+    async def submit(self, req: RateLimitReq, accumulate: bool = True,
+                     deadline: Optional[float] = None) -> RateLimitResp:
+        """Queue into the current window; resolves when the window executes.
+
+        With QoS active the request first passes admission control:
+        a full bounded queue or an unserviceable deadline (monotonic
+        absolute, see QoSManager.deadline_from_timeout) yields an in-band
+        shed response instead of queueing.  The admission slot is held
+        until the decision resolves, so `pending` counts real in-flight
+        decisions, not just the unflushed window."""
         if self._failed:
             raise RuntimeError("lockstep dispatch failed; "
                                "this host left the mesh")
+        if self.qos is None:
+            return await self._submit_admitted(req, accumulate)
+        reason = self.qos.admission.try_admit(1, deadline=deadline)
+        if reason is not None:
+            return shed_response(req, reason)
+        try:
+            return await self._submit_admitted(req, accumulate)
+        finally:
+            self.qos.admission.release(1)
+
+    async def _submit_admitted(self, req: RateLimitReq,
+                               accumulate: bool) -> RateLimitResp:
         if (self.pipeline is not None and accumulate
                 and (self.pipeline.eligible(req)
                      or self.pipeline.eligible_global(req))):
@@ -298,7 +342,7 @@ class WindowBatcher:
         self._pending.append((req, accumulate, fut))
         if self.clock is not None:
             return await fut  # the tick loop drains on the cluster cadence
-        if len(self._pending) >= self.behaviors.batch_limit:
+        if len(self._pending) >= self._window_limit():
             self._flush()
         elif len(self._pending) == 1:
             if self._interval is None:
@@ -316,6 +360,20 @@ class WindowBatcher:
     def _flush(self) -> None:
         window = self._pending
         self._pending = []
+        if self.qos is not None:
+            if self.qos.fair_slotting:
+                window = interleave_by_tenant(window, lambda t: t[0].name)
+            # the congestion window caps decisions-per-dispatch: the excess
+            # stays queued for the next cycle (and re-arms the timer so it
+            # cannot strand if no further submit arrives)
+            limit = self._window_limit()
+            if len(window) > limit:
+                window, self._pending = window[:limit], window[limit:]
+                if self._interval is None:
+                    self._interval = ArmedInterval(self.behaviors.batch_wait)
+                self._interval.arm()
+                if self._waiter is None or self._waiter.done():
+                    self._waiter = asyncio.create_task(self._wait_interval())
         asyncio.create_task(self._run_window(window))
 
     async def _run_window(self, window: List[tuple]) -> None:
@@ -334,6 +392,8 @@ class WindowBatcher:
                 if not fut.done():
                     fut.set_exception(e)
             return
+        if self.qos is not None:
+            self.qos.congestion.observe_drain(time.monotonic() - start)
         if self.metrics is not None:
             self.metrics.window_count.inc()
             self.metrics.window_occupancy.observe(len(reqs))

@@ -64,6 +64,7 @@ from gubernator_tpu.api.types import (
 from gubernator_tpu.config import MAX_BATCH_SIZE
 from gubernator_tpu.core.engine import PIPELINE_K_BUCKETS
 from gubernator_tpu.ops import kernel
+from gubernator_tpu.qos import interleave_by_tenant
 
 log = logging.getLogger("gubernator.pipeline")
 
@@ -338,8 +339,13 @@ class DispatchPipeline:
 
     def __init__(self, engine, engine_executor: ThreadPoolExecutor,
                  metrics=None, k_max: int = PIPELINE_K_BUCKETS[-1],
-                 depth: int = 3, lockstep: Optional[bool] = None):
+                 depth: int = 3, lockstep: Optional[bool] = None,
+                 qos=None):
         self.engine = engine
+        # QoSManager or None: feeds the AIMD from observed drain wall time
+        # and caps decisions-per-drain + in-flight depth by the congestion
+        # window (None = legacy static behavior, used by existing tests)
+        self.qos = qos
         # LOCKSTEP mode (any engine served behind a cluster tick clock;
         # REQUIRED for multiprocess engines): staging is continuous, but
         # drains dispatch only on the tick (lockstep_pump) with a fixed
@@ -528,6 +534,20 @@ class DispatchPipeline:
         jobs: List[object] = []
         if self._singles:
             singles, self._singles = self._singles, []
+            if self.qos is not None:
+                if self.qos.fair_slotting:
+                    # tenant-fair lane filling: a hot tenant's burst must
+                    # not occupy every lane of the drain (stable within
+                    # tenant, so per-key order is preserved)
+                    singles = interleave_by_tenant(
+                        singles, lambda t: t[0].name)
+                # the congestion window caps decisions-per-drain; the
+                # excess stays queued and rides the next pump (completion
+                # callbacks re-pump with force=True)
+                budget = self.qos.congestion.effective_window()
+                if len(singles) > budget:
+                    singles, self._singles = (singles[:budget],
+                                              singles[budget:])
             for base in range(0, len(singles), MAX_BATCH_SIZE):
                 chunk = singles[base:base + MAX_BATCH_SIZE]
                 jobs.append(ListJob([r for r, _ in chunk],
@@ -539,7 +559,9 @@ class DispatchPipeline:
     def _pump(self, force: bool = False) -> None:
         if self.lockstep:
             return  # drains happen only on the cluster tick (lockstep_pump)
-        if self._closed or self._in_flight >= self.depth:
+        depth = (self.depth if self.qos is None
+                 else self.qos.congestion.effective_depth(self.depth))
+        if self._closed or self._in_flight >= depth:
             return
         if not force and self.coalesce_wait > 0:
             # RpcJobs are unparsed here: estimate items from the wire size
@@ -756,6 +778,11 @@ class DispatchPipeline:
             else:
                 if not job.fut.done():
                     job.fut.set_result(out)
+        if self.qos is not None and res.n_decisions:
+            # the AIMD's congestion signal: wall time from drain start
+            # through fetch+demux, weighted by occupied window depth
+            self.qos.congestion.observe_drain(
+                time.monotonic() - res.started, depth=max(1, res.k_used))
         if self.metrics is not None:
             self.metrics.window_count.inc()
             self.metrics.window_occupancy.observe(res.n_decisions)

@@ -31,10 +31,12 @@ from gubernator_tpu.config import MAX_BATCH_SIZE, Config, PeerInfo
 from gubernator_tpu.core.batcher import WindowBatcher
 from gubernator_tpu.core.engine import RateLimitEngine
 from gubernator_tpu.core.global_sync import GlobalManager
-from gubernator_tpu.net.peers import PeerClient
+from gubernator_tpu.net.peers import BreakerOpenError, PeerClient
 from gubernator_tpu.parallel.router import MeshShardPicker
 from gubernator_tpu.observability.metrics import Metrics
 from gubernator_tpu.parallel.router import ConsistentHashRing
+from gubernator_tpu.qos import QoSManager, shed_response
+from gubernator_tpu.qos.admission import SHED_BREAKER_OPEN
 
 HEALTHY = "healthy"
 UNHEALTHY = "unhealthy"
@@ -75,6 +77,13 @@ class Instance:
             replay_cap=e.replay_cap,
         )
         self.metrics.watch_engine(self.engine)
+        # QoS control plane (gubernator_tpu/qos/): admission, congestion
+        # window, fairness, breaker policy.  Disabled => every path below
+        # behaves exactly like the seed.
+        self.qos: Optional[QoSManager] = None
+        if self.conf.qos.enabled:
+            self.qos = QoSManager(self.conf.qos, metrics=self.metrics)
+            self.metrics.watch_qos(self.qos)
         self.mesh_mode = mesh_peers is not None
         clock = None
         if self.mesh_mode:
@@ -86,7 +95,8 @@ class Instance:
             clock = LockstepClock(agree_epoch_ms(self.engine.mesh),
                                   self.conf.behaviors.batch_wait)
         self.batcher = WindowBatcher(self.engine, self.conf.behaviors,
-                                     self.metrics, lockstep_clock=clock)
+                                     self.metrics, lockstep_clock=clock,
+                                     qos=self.qos)
         self.global_mgr = GlobalManager(
             self.conf.behaviors, self, self.metrics, log)
         if self.mesh_mode:
@@ -118,13 +128,22 @@ class Instance:
 
     # ------------------------------------------------------------ public API
 
-    async def get_rate_limits(self, requests: Sequence[RateLimitReq]) -> List[RateLimitResp]:
+    async def get_rate_limits(
+        self, requests: Sequence[RateLimitReq],
+        deadline: Optional[float] = None,
+    ) -> List[RateLimitResp]:
+        """deadline: absolute monotonic deadline propagated from the
+        transport (gRPC context.time_remaining(), HTTP timeout header) —
+        admission sheds requests it cannot serve in time (qos/admission.py).
+        """
         if len(requests) > MAX_BATCH_SIZE:
             raise BatchTooLargeError(
                 f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'")
-        return list(await asyncio.gather(*(self._route(r) for r in requests)))
+        return list(await asyncio.gather(
+            *(self._route(r, deadline) for r in requests)))
 
-    async def _route(self, r: RateLimitReq) -> RateLimitResp:
+    async def _route(self, r: RateLimitReq,
+                     deadline: Optional[float] = None) -> RateLimitResp:
         key = r.hash_key()
         # validation: exact reference strings and order (gubernator.go:102-110)
         if not r.unique_key:
@@ -140,7 +159,7 @@ class Instance:
 
         # standalone (no peer ring): every key is ours
         if self._picker.size() == 0:
-            return await self._local(r)
+            return await self._local(r, deadline)
 
         if r.behavior == Behavior.GLOBAL and self.mesh_mode:
             # ownership is irrelevant here: after the window psum EVERY mesh
@@ -152,7 +171,7 @@ class Instance:
                     # analog: GLOBAL keys accepted on first use,
                     # global.go:62-68)
                     await self._ensure_global_registered(r)
-                return await self.batcher.submit(r)
+                return await self.batcher.submit(r, deadline=deadline)
             except Exception as e:
                 # per-item failure (e.g. unregistered GLOBAL key failed
                 # individually by _take_window) must not abort the whole
@@ -168,7 +187,7 @@ class Instance:
 
         if peer.is_owner:
             try:
-                return await self._local(r)
+                return await self._local(r, deadline)
             except Exception as e:
                 return RateLimitResp(
                     error=f"while applying rate limit for '{key}' - '{e}'")
@@ -182,6 +201,8 @@ class Instance:
 
         try:
             resp = await peer.get_peer_rate_limit(r)
+        except BreakerOpenError:
+            return await self._breaker_fallback(r, peer.host, deadline)
         except Exception as e:
             return RateLimitResp(
                 error=f"while fetching rate limit '{key}' from peer - '{e}'")
@@ -189,7 +210,27 @@ class Instance:
         resp.metadata = dict(resp.metadata or {}, owner=peer.host)
         return resp
 
-    async def _local(self, r: RateLimitReq) -> RateLimitResp:
+    async def _breaker_fallback(self, r: RateLimitReq, host: str,
+                                deadline: Optional[float]) -> RateLimitResp:
+        """The owner's circuit breaker is open.  fail_open: answer from the
+        LOCAL engine — a non-authoritative decision (this node's window
+        state, not the owner's), flagged in metadata so honest clients know
+        enforcement is degraded rather than wrong silently.  fail_closed:
+        shed in-band with reason breaker_open."""
+        fail_open = (self.qos.fail_open if self.qos is not None
+                     else self.conf.qos.fail_open)
+        if not fail_open:
+            if self.qos is not None:
+                self.qos.admission.record_shed(SHED_BREAKER_OPEN)
+            return shed_response(r, SHED_BREAKER_OPEN)
+        resp = await self._local(r, deadline)
+        resp.metadata = dict(resp.metadata or {}, owner=host,
+                             degraded="true", non_authoritative="true")
+        self.metrics.fail_open_served.inc()
+        return resp
+
+    async def _local(self, r: RateLimitReq,
+                     deadline: Optional[float] = None) -> RateLimitResp:
         """Owner-side decision through the device engine (the reference's
         getRateLimit under the cache mutex, gubernator.go:236-251)."""
         if (r.behavior == Behavior.GLOBAL and self._picker.size() > 0
@@ -198,8 +239,11 @@ class Instance:
             # (gubernator.go:240-242)
             self.global_mgr.queue_update(r)
         if r.behavior == Behavior.NO_BATCHING:
+            # deliberately NOT gated by admission: NO_BATCHING is the
+            # jump-the-window lane and keeps working while the batched
+            # lane saturates (tests/test_qos.py asserts this)
             return (await self.batcher.submit_now([r]))[0]
-        return await self.batcher.submit(r)
+        return await self.batcher.submit(r, deadline=deadline)
 
     async def _global_nonowner(self, r: RateLimitReq) -> RateLimitResp:
         """Non-owner GLOBAL: answer from the local replica, reconcile hits
@@ -325,6 +369,22 @@ class Instance:
         return resp
 
     async def health_check(self) -> HealthCheckResp:
+        """Liveness is more than the last set_peers result: a batcher that
+        fail-stopped (lockstep dispatch failure — this host left the mesh)
+        or an admission queue pinned at its cap means this node cannot
+        serve, whatever the ring looked like when it was built."""
+        if self.batcher._failed:
+            return HealthCheckResp(
+                status=UNHEALTHY,
+                message="lockstep dispatch failed; this host left the mesh",
+                peer_count=self.health.peer_count)
+        if self.qos is not None and self.qos.admission.saturated:
+            return HealthCheckResp(
+                status=UNHEALTHY,
+                message=(f"admission queue saturated "
+                         f"({self.qos.admission.pending} pending, "
+                         f"cap {self.qos.admission.max_pending})"),
+                peer_count=self.health.peer_count)
         return self.health
 
     # ------------------------------------------------------------ membership
@@ -345,7 +405,8 @@ class Instance:
             client = self._picker.get_by_host(info.address)
             if client is None:
                 try:
-                    client = PeerClient(self.conf.behaviors, info.address)
+                    client = PeerClient(self.conf.behaviors, info.address,
+                                        qos=self.qos)
                 except Exception:
                     errs.append(
                         f"failed to connect to peer '{info.address}'; "

@@ -103,6 +103,7 @@ class Daemon:
             behaviors=c.behaviors,
             engine=c.engine,
             advertise_address=c.advertise_address,
+            qos=c.qos,
         ), mesh=mesh, mesh_peers=mesh_peers)
         # compile the device step before accepting traffic; mesh mode needs a
         # cluster-agreed timestamp (all processes warm up in lockstep)

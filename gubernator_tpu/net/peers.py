@@ -13,6 +13,7 @@ chips and talk via collectives, not RPCs (SURVEY.md §2 parallelism table).
 from __future__ import annotations
 
 import asyncio
+import logging
 from typing import List, Optional
 
 import grpc
@@ -20,12 +21,51 @@ import grpc
 from gubernator_tpu.api import pb
 from gubernator_tpu.api.grpc_api import PeersV1Stub
 from gubernator_tpu.api.types import Behavior, RateLimitReq, RateLimitResp
-from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.config import BehaviorConfig, QoSConfig
 from gubernator_tpu.core.interval import ArmedInterval
+from gubernator_tpu.qos.breaker import CircuitBreaker, backoff_delays
+
+log = logging.getLogger("gubernator.peers")
+
+
+class PeerError(Exception):
+    """Typed peer-lane failure with the peer host attached.
+
+    Every transport failure on the forward lane (raw AioRpcError, asyncio
+    timeout) normalizes to this, so shed/fallback logic and tests match on
+    a stable type instead of grpc internals.  `retryable` marks transient
+    transport conditions (UNAVAILABLE / DEADLINE_EXCEEDED) that count
+    against the peer's circuit breaker."""
+
+    def __init__(self, host: str, message: str, code=None,
+                 retryable: bool = False):
+        self.host = host
+        self.code = code
+        self.retryable = retryable
+        super().__init__(f"peer '{host}': {message}")
+
+
+class BreakerOpenError(PeerError):
+    """The peer's circuit breaker is open: the call was rejected locally
+    without touching the network.  core/service.py turns this into the
+    configured fail-open (local non-authoritative answer) or fail-closed
+    (in-band shed) behavior."""
+
+    def __init__(self, host: str):
+        super().__init__(host, "circuit breaker open", retryable=False)
+
+
+# transient transport conditions: retried with jittered backoff and
+# counted against the breaker (everything else is the caller's problem)
+_TRANSIENT_CODES = (grpc.StatusCode.UNAVAILABLE,
+                    grpc.StatusCode.DEADLINE_EXCEEDED)
 
 
 class PeerClient:
-    def __init__(self, behaviors: BehaviorConfig, host: str):
+    def __init__(self, behaviors: BehaviorConfig, host: str, qos=None):
+        """qos: the Instance's QoSManager — supplies the breaker (with its
+        injectable clock + state-gauge hook) and retry policy.  None gets
+        default-config resilience (standalone embedding, tests)."""
         self.host = host
         self.conf = behaviors
         self.is_owner = False  # True when this entry names the local instance
@@ -37,6 +77,78 @@ class PeerClient:
         self._pending: List[tuple] = []  # (req, future)
         self._interval: Optional[ArmedInterval] = None
         self._waiter: Optional[asyncio.Task] = None
+        # ---- resilience (gubernator_tpu/qos/breaker.py)
+        self._qos = qos
+        qconf = qos.conf if qos is not None else QoSConfig()
+        self.retries = qconf.peer_retries
+        self.retry_base = qconf.retry_base
+        self.retry_cap = qconf.retry_cap
+        self.breaker = (qos.make_breaker(host) if qos is not None
+                        else CircuitBreaker(
+                            fail_threshold=qconf.breaker_fail_threshold,
+                            open_duration=qconf.breaker_open_duration,
+                            half_open_probes=qconf.breaker_half_open_probes))
+        self._sleep = asyncio.sleep  # injectable for deterministic tests
+
+    # ------------------------------------------------------------ resilience
+
+    @staticmethod
+    def _normalize(host: str, e: Exception) -> PeerError:
+        """Fold any transport failure into a typed PeerError."""
+        if isinstance(e, PeerError):
+            return e
+        code = None
+        code_fn = getattr(e, "code", None)
+        if callable(code_fn):
+            try:
+                code = code_fn()
+            except Exception:
+                code = None
+        if isinstance(e, (asyncio.TimeoutError, TimeoutError)):
+            return PeerError(host, "request timed out",
+                             code=grpc.StatusCode.DEADLINE_EXCEEDED,
+                             retryable=True)
+        details_fn = getattr(e, "details", None)
+        msg = None
+        if callable(details_fn):
+            try:
+                msg = details_fn()
+            except Exception:
+                msg = None
+        return PeerError(host, msg or str(e), code=code,
+                         retryable=code in _TRANSIENT_CODES)
+
+    async def _call(self, do):
+        """Run one RPC attempt closure through the resilience layer:
+        breaker gate -> attempt -> jittered-backoff retries on transient
+        UNAVAILABLE-class failures -> typed PeerError out.  Success and
+        (final) transient failure feed the breaker; non-transient errors
+        (bad request, peer-side app errors) do not trip it."""
+        if not self.breaker.allow():
+            raise BreakerOpenError(self.host)
+        delays = backoff_delays(self.retries, self.retry_base, self.retry_cap)
+        attempt = 0
+        while True:
+            try:
+                out = await do()
+            except (grpc.RpcError, asyncio.TimeoutError, TimeoutError) as e:
+                err = self._normalize(self.host, e)
+                if err.retryable and attempt < self.retries:
+                    attempt += 1
+                    if (self._qos is not None
+                            and self._qos.metrics is not None):
+                        self._qos.metrics.observe_peer_retry(self.host)
+                    await self._sleep(next(delays))
+                    continue
+                if err.retryable:
+                    self.breaker.record_failure()
+                else:
+                    # the peer answered (with an application error): it is
+                    # alive, which is what the breaker tracks
+                    self.breaker.record_success()
+                raise err from e
+            self.breaker.record_success()
+            return out
 
     # ------------------------------------------------------------ forwarding
 
@@ -50,7 +162,8 @@ class PeerClient:
     async def get_peer_rate_limits(self, reqs: List[RateLimitReq]) -> List[RateLimitResp]:
         """One unary batch RPC; validates response length (peers.go:93-105)."""
         msg = pb.GetPeerRateLimitsReq(requests=[pb.req_to_pb(r) for r in reqs])
-        resp = await self.stub.GetPeerRateLimits(msg, timeout=self.conf.batch_timeout)
+        resp = await self._call(lambda: self.stub.GetPeerRateLimits(
+            msg, timeout=self.conf.batch_timeout))
         if len(resp.rate_limits) != len(reqs):
             raise RuntimeError(
                 "number of rate limits in peer response does not match request")
@@ -67,7 +180,8 @@ class PeerClient:
             )
             for g in globals_
         ])
-        await self.stub.UpdatePeerGlobals(msg, timeout=self.conf.global_timeout)
+        await self._call(lambda: self.stub.UpdatePeerGlobals(
+            msg, timeout=self.conf.global_timeout))
 
     async def get_peer_rate_limits_raw(self, data: bytes) -> bytes:
         """Bytes-level batch relay: the caller splices serialized
@@ -79,7 +193,8 @@ class PeerClient:
                 "/pb.gubernator.PeersV1/GetPeerRateLimits",
                 request_serializer=lambda b: b,
                 response_deserializer=lambda b: b)
-        return await self._raw_batch(data, timeout=self.conf.batch_timeout)
+        return await self._call(lambda: self._raw_batch(
+            data, timeout=self.conf.batch_timeout))
 
     async def transfer_buckets(self, payload: bytes) -> bytes:
         """Ship migrated bucket rows to this peer (state/migrate.py wire
@@ -90,8 +205,8 @@ class PeerClient:
                 "/pb.gubernator.PeersV1/TransferBuckets",
                 request_serializer=lambda b: b,
                 response_deserializer=lambda b: b)
-        return await self._raw_transfer(payload,
-                                        timeout=self.conf.batch_timeout)
+        return await self._call(lambda: self._raw_transfer(
+            payload, timeout=self.conf.batch_timeout))
 
     async def register_globals(self, specs: List[tuple]) -> None:
         """Forward (key, limit, duration, algorithm) registrations to the
@@ -100,7 +215,8 @@ class PeerClient:
             pb.GlobalSpec(key=k, limit=lim, duration=dur, algorithm=int(a))
             for (k, lim, dur, a) in specs
         ])
-        await self.stub.RegisterGlobals(msg, timeout=self.conf.global_timeout)
+        await self._call(lambda: self.stub.RegisterGlobals(
+            msg, timeout=self.conf.global_timeout))
 
     async def apply_global_registration(self, specs: List[tuple], now: int,
                                         activate: bool) -> None:
@@ -110,8 +226,8 @@ class PeerClient:
                                  algorithm=int(a))
                    for (k, lim, dur, a) in specs],
             now=now, activate=activate)
-        await self.stub.ApplyGlobalRegistration(
-            msg, timeout=self.conf.global_timeout)
+        await self._call(lambda: self.stub.ApplyGlobalRegistration(
+            msg, timeout=self.conf.global_timeout))
 
     # -------------------------------------------------------------- batching
 

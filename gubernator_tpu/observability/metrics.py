@@ -151,6 +151,53 @@ class Metrics:
             "existed.",
             registry=self.registry,
         )
+        # QoS subsystem (gubernator_tpu/qos/): admission queue, sheds by
+        # reason, the AIMD window, and per-peer breaker state
+        self.qos_queue_depth = Gauge(
+            "guber_qos_queue_depth",
+            "Pending decisions held in the bounded admission queue.",
+            registry=self.registry,
+        )
+        self.qos_shed = Counter(
+            "guber_qos_shed_total",
+            "Requests shed by admission control, by reason.",
+            ["reason"],  # queue_full | deadline | breaker_open
+            registry=self.registry,
+        )
+        self.qos_effective_window = Gauge(
+            "guber_qos_effective_window",
+            "Congestion-adaptive window size (decisions per dispatch).",
+            registry=self.registry,
+        )
+        self.qos_drain_latency_ewma = Gauge(
+            "guber_qos_drain_latency_ewma_seconds",
+            "EWMA of observed drain wall time feeding the AIMD.",
+            registry=self.registry,
+        )
+        self.qos_drain_depth_ewma = Gauge(
+            "guber_qos_drain_depth_ewma",
+            "EWMA of occupied drain depth feeding the AIMD.",
+            registry=self.registry,
+        )
+        self.breaker_state = Gauge(
+            "guber_qos_breaker_state",
+            "Per-peer circuit breaker state "
+            "(0=closed, 1=half_open, 2=open).",
+            ["peer"],
+            registry=self.registry,
+        )
+        self.peer_retries = Counter(
+            "guber_qos_peer_retries_total",
+            "Peer-lane RPC retries after transient failures.",
+            ["peer"],
+            registry=self.registry,
+        )
+        self.fail_open_served = Counter(
+            "guber_qos_fail_open_total",
+            "Forwards answered locally (non-authoritative) while the "
+            "owner's breaker was open.",
+            registry=self.registry,
+        )
 
     def add_scrape_hook(self, fn) -> None:
         """Register a callable run before every expose() — the analog of the
@@ -181,6 +228,31 @@ class Metrics:
                 last["miss"] = st["misses"]
 
         self.add_scrape_hook(refresh)
+
+    def watch_qos(self, qos) -> None:
+        """Export the QoS control state at scrape time: queue depth, the
+        adaptive window, and the drain-latency EWMA all from the same
+        QoSManager read."""
+
+        def refresh():
+            self.qos_queue_depth.set(qos.admission.pending)
+            self.qos_effective_window.set(qos.congestion.effective_window())
+            self.qos_drain_latency_ewma.set(qos.congestion.latency_ewma)
+            self.qos_drain_depth_ewma.set(qos.congestion.depth_ewma)
+
+        self.add_scrape_hook(refresh)
+
+    def observe_shed(self, reason: str, n: int = 1) -> None:
+        self.qos_shed.labels(reason=reason).inc(n)
+
+    _BREAKER_STATES = {"closed": 0, "half_open": 1, "open": 2}
+
+    def observe_breaker(self, peer: str, state: str) -> None:
+        self.breaker_state.labels(peer=peer).set(
+            self._BREAKER_STATES.get(state, 0))
+
+    def observe_peer_retry(self, peer: str) -> None:
+        self.peer_retries.labels(peer=peer).inc()
 
     def observe_snapshot(self, seconds: float, size_bytes: int,
                          ok: bool) -> None:

@@ -1,0 +1,101 @@
+"""AIMD congestion controller over observed drain latency.
+
+Replaces the static `batch_limit=1000` cliff with a congestion window
+(`cwnd`, in DECISIONS per dispatch) adapted the way TCP adapts to RTT
+inflation — the CONCUR structure (arxiv 2601.22705) specialized to the
+one-engine-thread drain: the observed signal is the wall time of a whole
+drain cycle (dispatch + fetch), the EWMA of which inflates as soon as the
+device or the fetch link saturates.
+
+  * below target latency: additive increase (`cwnd += increase`) per
+    observation — probe for more batching, which on this hardware is
+    nearly free until the transfer link saturates;
+  * above target latency: multiplicative decrease (`cwnd *= decrease`),
+    at most once per cooldown window (one "RTT": the larger of the EWMA
+    and the target), so a burst of stale in-flight drains completing
+    late doesn't collapse the window to the floor in one tick.
+
+The controller never gates correctness — it only decides how much pending
+work each dispatch takes (core/batcher.py window fill, core/pipeline.py
+per-drain budget and in-flight depth) and feeds the admission
+controller's wait estimate.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class CongestionController:
+    def __init__(self, conf, now_fn=time.monotonic):
+        self.min_window = conf.min_window
+        self.max_window = conf.max_window
+        self.target_latency = conf.target_drain_latency
+        self.increase = conf.aimd_increase
+        self.decrease = conf.aimd_decrease
+        self.alpha = conf.latency_ewma_alpha
+        self.now_fn = now_fn
+        self._cwnd = float(conf.max_window)
+        self.latency_ewma = 0.0
+        self.depth_ewma = 0.0
+        self._observed = False
+        self._last_decrease = float("-inf")
+        # telemetry for tests/metrics
+        self.decreases = 0
+        self.increases = 0
+
+    # ------------------------------------------------------------- signal
+
+    def observe_drain(self, wall_seconds: float, depth: int = 1) -> None:
+        """Feed one completed drain cycle (engine dispatch through fetch).
+        `depth` is the occupied window depth K of the drain (EWMA'd for
+        the metrics surface and the wait estimator)."""
+        a = self.alpha
+        if not self._observed:
+            self.latency_ewma = wall_seconds
+            self.depth_ewma = float(depth)
+            self._observed = True
+        else:
+            self.latency_ewma += a * (wall_seconds - self.latency_ewma)
+            self.depth_ewma += a * (depth - self.depth_ewma)
+        if self.latency_ewma > self.target_latency:
+            now = self.now_fn()
+            cooldown = max(self.latency_ewma, self.target_latency)
+            if now - self._last_decrease >= cooldown:
+                self._cwnd = max(float(self.min_window),
+                                 self._cwnd * self.decrease)
+                self._last_decrease = now
+                self.decreases += 1
+        else:
+            if self._cwnd < self.max_window:
+                self._cwnd = min(float(self.max_window),
+                                 self._cwnd + self.increase)
+                self.increases += 1
+
+    # ------------------------------------------------------------- policy
+
+    def effective_window(self) -> int:
+        """Decisions one dispatch should take (window fill / drain budget)."""
+        return max(self.min_window, int(self._cwnd))
+
+    def effective_depth(self, max_depth: int) -> int:
+        """In-flight drain cap scaled with the congestion window: at full
+        cwnd the pipeline keeps its configured depth; as AIMD backs off,
+        fewer drains ride concurrently (dispatch cadence slows with the
+        same control signal)."""
+        if self.max_window <= 0:
+            return max_depth
+        frac = self._cwnd / float(self.max_window)
+        return max(1, min(max_depth, round(max_depth * frac)))
+
+    def drain_cycle_estimate(self) -> float:
+        """Expected wall time of one drain cycle, for the admission wait
+        estimator.  Before any observation the target is the prior — a
+        fresh node must not promise instant service to a 1ms deadline."""
+        if not self._observed:
+            return self.target_latency
+        return max(self.latency_ewma, 1e-6)
+
+    @property
+    def congested(self) -> bool:
+        return self._observed and self.latency_ewma > self.target_latency

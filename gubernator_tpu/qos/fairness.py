@@ -1,0 +1,57 @@
+"""Per-tenant weighted fair slotting for device windows.
+
+A device window has a fixed number of lanes; filling it FIFO means one
+hot tenant's burst occupies every lane and everyone else waits a full
+window cycle per burst.  `interleave_by_tenant` reorders a pending list
+round-robin across `name` (tenant) groups — stable WITHIN each tenant, so
+per-key sequential semantics are untouched (two requests for the same key
+share a tenant and keep their relative order; reordering across different
+keys is always commutative for the engine).
+
+Weighted: a tenant's integer weight (default 1) is how many slots it
+takes per round-robin pass, so operators can deliberately favor a tenant
+without letting it starve the rest.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, TypeVar
+
+T = TypeVar("T")
+
+
+def interleave_by_tenant(
+    items: Sequence[T],
+    tenant_of: Callable[[T], str],
+    weight_of: Optional[Callable[[str], int]] = None,
+) -> List[T]:
+    """Round-robin interleave across tenant groups (first-seen tenant
+    order), stable within each group.  Single-tenant input returns the
+    original order unchanged (and unallocated)."""
+    groups: dict = {}
+    order: List[str] = []
+    for it in items:
+        t = tenant_of(it)
+        g = groups.get(t)
+        if g is None:
+            groups[t] = g = []
+            order.append(t)
+        g.append(it)
+    if len(order) <= 1:
+        return list(items)
+    cursors = {t: 0 for t in order}
+    weights = {t: max(1, int(weight_of(t))) if weight_of else 1
+               for t in order}
+    out: List[T] = []
+    remaining = len(items)
+    while remaining:
+        for t in order:
+            g = groups[t]
+            i = cursors[t]
+            take = min(weights[t], len(g) - i)
+            if take <= 0:
+                continue
+            out.extend(g[i:i + take])
+            cursors[t] = i + take
+            remaining -= take
+    return out

@@ -33,7 +33,14 @@ class _V1Servicer:
         inst = self.instance
         m = inst.metrics
         start = time.monotonic()
-        if not inst.mesh_mode and len(data) >= FASTPATH_MIN_BYTES:
+        # QoS: propagate the client's gRPC deadline into admission control,
+        # and BYPASS the bytes-level native lane while the admission queue
+        # is saturated — sheds must be decided per item on the Python path
+        # so the response carries shed_reason metadata in-band
+        qos_saturated = (inst.qos is not None
+                         and inst.qos.admission.saturated)
+        if (not inst.mesh_mode and not qos_saturated
+                and len(data) >= FASTPATH_MIN_BYTES):
             # native RPC lane: C parse -> stacked compact dispatch -> C
             # encode (core/pipeline.py).  In cluster mode the C parser
             # classifies items per key against the installed ring and
@@ -52,9 +59,17 @@ class _V1Servicer:
             m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=False)
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                                 "malformed GetRateLimitsReq")
+        deadline = None
+        if inst.qos is not None:
+            remaining = None
+            tr = getattr(context, "time_remaining", None)
+            if callable(tr):
+                remaining = tr()
+            deadline = inst.qos.deadline_from_timeout(remaining)
         try:
             resps = await inst.get_rate_limits(
-                [pb.req_from_pb(r) for r in request.requests])
+                [pb.req_from_pb(r) for r in request.requests],
+                deadline=deadline)
         except BatchTooLargeError as e:
             m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=False)
             await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))

@@ -1,0 +1,153 @@
+"""Open-loop overload probe for the QoS subsystem (gubernator_tpu/qos/).
+
+Closed-loop load generators (cmd/cli.py `load`) self-throttle when the
+server slows down, so they can never show congestion collapse.  This
+probe is open-loop: it issues requests on a fixed arrival schedule
+regardless of completions — exactly the regime admission control exists
+for — and reports, at 1x/2x/5x of measured capacity:
+
+    offered rps | goodput (served/s) | shed rate | p50/p99 served latency
+
+A healthy QoS config keeps goodput ~flat across the sweep (the extra
+offered load is shed in-band at admission, before it can queue) and the
+served p99 bounded by the drain cycle, not the backlog.
+
+Runs in-process against a CPU Instance by default so it works anywhere:
+
+    JAX_PLATFORMS=cpu python scripts/probe_overload.py
+    JAX_PLATFORMS=cpu python scripts/probe_overload.py \
+        --max-pending 256 --seconds 3 --multiples 1 2 5 10
+"""
+import argparse
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_instance(args):
+    from gubernator_tpu.config import (BehaviorConfig, Config, EngineConfig,
+                                       QoSConfig)
+    from gubernator_tpu.core.service import Instance
+    inst = Instance(Config(
+        behaviors=BehaviorConfig(),
+        engine=EngineConfig(
+            capacity_per_shard=args.capacity_per_shard,
+            batch_per_shard=args.batch_per_shard,
+            use_native=not args.no_native),
+        qos=QoSConfig(max_pending=args.max_pending,
+                      target_drain_latency=args.target_drain_ms / 1000.0)))
+    inst.engine.warmup()
+    return inst
+
+
+def make_req(i):
+    from gubernator_tpu.api.types import RateLimitReq, Second
+    return RateLimitReq(name=f"tenant-{i % 8}", unique_key=f"probe-{i}",
+                        hits=1, limit=1 << 30, duration=60 * Second)
+
+
+async def measure_capacity(inst, seconds):
+    """Closed-loop saturation run: ceiling decisions/s with no queueing."""
+    i = 0
+    done = 0
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        resps = await inst.get_rate_limits([make_req(i + j)
+                                            for j in range(64)])
+        done += len(resps)
+        i += 64
+    return done / seconds
+
+
+async def open_loop(inst, rps, seconds):
+    """Issue singles at a fixed schedule; never waits for completions."""
+    interval = 1.0 / rps
+    served = shed = errors = 0
+    lat = []
+    tasks = []
+    start = time.monotonic()
+    i = 0
+
+    async def one(idx):
+        nonlocal served, shed, errors
+        t0 = time.monotonic()
+        try:
+            r = (await inst.get_rate_limits([make_req(idx)]))[0]
+        except Exception:
+            errors += 1
+            return
+        if (r.metadata or {}).get("shed_reason"):
+            shed += 1
+        elif r.error:
+            errors += 1
+        else:
+            served += 1
+            lat.append(time.monotonic() - t0)
+
+    while True:
+        now = time.monotonic()
+        if now - start >= seconds:
+            break
+        due = start + i * interval
+        if now < due:
+            await asyncio.sleep(due - now)
+        tasks.append(asyncio.ensure_future(one(i)))
+        i += 1
+    await asyncio.gather(*tasks)
+    wall = time.monotonic() - start
+    lat.sort()
+
+    def pct(p):
+        return lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3 if lat else 0.0
+
+    return dict(offered=i / wall, goodput=served / wall,
+                shed_rate=shed / max(1, i), errors=errors,
+                p50=pct(0.50), p99=pct(0.99))
+
+
+async def amain(args):
+    inst = build_instance(args)
+    try:
+        print("measuring closed-loop capacity...", flush=True)
+        cap = await measure_capacity(inst, args.seconds)
+        print(f"capacity ~= {cap:,.0f} decisions/s "
+              f"(max_pending={args.max_pending})\n", flush=True)
+        print(f"{'offered':>12} {'goodput':>12} {'shed':>7} "
+              f"{'p50 ms':>8} {'p99 ms':>8}")
+        for m in args.multiples:
+            rps = min(cap * m, args.rps_ceiling)
+            r = await open_loop(inst, rps, args.seconds)
+            print(f"{r['offered']:>10,.0f}/s {r['goodput']:>10,.0f}/s "
+                  f"{r['shed_rate']:>6.1%} {r['p50']:>8.2f} {r['p99']:>8.2f}"
+                  f"   ({m}x" + (f", {r['errors']} errors" if r['errors']
+                                 else "") + ")", flush=True)
+        peak = inst.qos.admission.pending_peak if inst.qos else 0
+        print(f"\npending peak {peak} (cap {args.max_pending}); "
+              f"effective window "
+              f"{inst.qos.congestion.effective_window() if inst.qos else '-'}")
+    finally:
+        inst.close()
+
+
+def main():
+    p = argparse.ArgumentParser("probe_overload")
+    p.add_argument("--seconds", type=float, default=2.0,
+                   help="duration of each load step")
+    p.add_argument("--multiples", type=float, nargs="+", default=[1, 2, 5],
+                   help="offered-load multiples of measured capacity")
+    p.add_argument("--max-pending", type=int, default=512)
+    p.add_argument("--target-drain-ms", type=float, default=100.0)
+    p.add_argument("--capacity-per-shard", type=int, default=1 << 14)
+    p.add_argument("--batch-per-shard", type=int, default=512)
+    p.add_argument("--no-native", action="store_true",
+                   help="force the Python window path (classic batcher)")
+    p.add_argument("--rps-ceiling", type=float, default=50_000.0,
+                   help="cap the open-loop scheduler (CPU event-loop limit)")
+    asyncio.run(amain(p.parse_args()))
+
+
+if __name__ == "__main__":
+    main()
