@@ -1,7 +1,7 @@
 # Dev targets (the reference Makefile:1-15 has only release/docker; we add
 # the working set).
 
-.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos proto bench docker lint cluster
+.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos test-obs proto bench docker lint cluster
 
 test:
 	python -m pytest tests/ -x -q
@@ -32,6 +32,12 @@ test-snapshot:
 # Part of tier-1 (`test-core` picks it up too); this target runs just it.
 test-qos:
 	python -m pytest tests/ -x -q -m "qos and not slow"
+
+# the observability slice: stitched cross-node traces, stage-latency
+# decomposition, metric-name parity, debug/profile admin plane.  Part of
+# tier-1 (`test-core` picks it up too); this target runs just the slice.
+test-obs:
+	python -m pytest tests/ -x -q -m "obs and not slow"
 
 proto:
 	cd gubernator_tpu/api/proto && protoc --python_out=. gubernator.proto peers.proto

@@ -9,6 +9,8 @@ conversion rules grpc-gateway uses):
   POST /v1/GetRateLimits   body: GetRateLimitsReq JSON
   GET  /v1/HealthCheck
   GET  /metrics            prometheus text format (main.go:113-116)
+  GET  /v1/admin/debug     runtime introspection snapshot (JSON)
+  POST /v1/admin/profile   arm a jax.profiler capture of the next N drains
 
 Unlike the gateway in the reference (which dials the node's own gRPC port
 over TCP), this calls the Instance in-process.
@@ -23,7 +25,11 @@ from google.protobuf import json_format
 
 from gubernator_tpu.api import pb
 from gubernator_tpu.core.service import BatchTooLargeError, Instance
-from gubernator_tpu.observability.metrics import CONTENT_TYPE_LATEST
+from gubernator_tpu.observability import (
+    CONTENT_TYPE_LATEST,
+    build_debug_snapshot,
+)
+from gubernator_tpu.observability.tracing import TRACEPARENT
 
 
 def build_app(instance: Instance) -> web.Application:
@@ -32,6 +38,20 @@ def build_app(instance: Instance) -> web.Application:
     # (prometheus.go:104-137).  This gateway is in-process, so the handlers
     # observe the same metric names themselves.
     async def get_rate_limits(request: web.Request) -> web.Response:
+        # HTTP leg of trace propagation: continue an incoming traceparent
+        # (or sample a new root) and echo the context back so callers can
+        # correlate their logs with ours
+        tracer = instance.tracer
+        if tracer is None or not tracer.enabled:
+            return await _get_rate_limits(request)
+        with tracer.start_trace(
+                "http", request.headers.get(TRACEPARENT)) as root:
+            resp = await _get_rate_limits(request)
+            if root.ctx is not None:
+                resp.headers[TRACEPARENT] = root.ctx.traceparent()
+            return resp
+
+    async def _get_rate_limits(request: web.Request) -> web.Response:
         m = instance.metrics
         start = time.monotonic()
         ok = False
@@ -86,9 +106,12 @@ def build_app(instance: Instance) -> web.Application:
             json_format.MessageToDict(msg, preserving_proto_field_name=False))
 
     async def metrics(request: web.Request) -> web.Response:
+        # the full prometheus content type, charset parameter included —
+        # aiohttp's content_type kwarg rejects parameters, so it goes in
+        # as a raw header
         return web.Response(
             body=instance.metrics.expose(),
-            content_type=CONTENT_TYPE_LATEST.split(";")[0],
+            headers={"Content-Type": CONTENT_TYPE_LATEST},
         )
 
     # state-lifecycle admin plane (cmd/cli.py snapshot/restore): the
@@ -111,6 +134,29 @@ def build_app(instance: Instance) -> web.Application:
                                      status=400)
         return web.json_response({"restoredKeys": n})
 
+    async def admin_debug(request: web.Request) -> web.Response:
+        return web.json_response(build_debug_snapshot(instance))
+
+    async def admin_profile(request: web.Request) -> web.Response:
+        body = {}
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except Exception:
+                return web.json_response(
+                    {"error": "malformed JSON body", "code": 3}, status=400)
+        drains = body.get("drains", request.query.get("drains", 1))
+        trace_dir = body.get("dir", request.query.get("dir", ""))
+        try:
+            drains = int(drains)
+        except (TypeError, ValueError):
+            return web.json_response({"error": "invalid drains", "code": 3},
+                                     status=400)
+        out = instance.batcher.profile.arm(drains, trace_dir)
+        # already-armed is a conflict, not a new capture
+        return web.json_response(out,
+                                 status=200 if out.get("armed") else 409)
+
     # a full-arena snapshot blob is tens of MB at default capacity — far
     # past aiohttp's 1 MiB default body cap, which would 413 every real
     # admin restore
@@ -120,6 +166,8 @@ def build_app(instance: Instance) -> web.Application:
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/v1/admin/snapshot", admin_snapshot)
     app.router.add_post("/v1/admin/restore", admin_restore)
+    app.router.add_get("/v1/admin/debug", admin_debug)
+    app.router.add_post("/v1/admin/profile", admin_profile)
     return app
 
 

@@ -81,6 +81,7 @@ class Cluster:
         server = GrpcServer(inst, address)
         await server.start()
         inst.advertise_address = server.address
+        inst.tracer.node = server.address
         node = ClusterNode(inst, server)
         self.nodes.append(node)
         await self._rewire()
@@ -138,6 +139,9 @@ async def start_with(
             server = GrpcServer(inst, addr)
             await server.start()
             inst.advertise_address = server.address
+            # ephemeral-port boot resolves the address late; re-label the
+            # tracer so stitched traces name each node distinctly
+            inst.tracer.node = server.address
             cluster.nodes.append(ClusterNode(inst, server))
 
         # compile the shared device step before serving — otherwise the first

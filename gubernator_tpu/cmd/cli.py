@@ -12,6 +12,12 @@ The snapshot/restore subcommands drive the daemon's HTTP admin plane
   python -m gubernator_tpu.cmd.cli snapshot <http-addr> -o arena.snap
   python -m gubernator_tpu.cmd.cli restore  <http-addr> arena.snap
                                             [--rebase-to-now]
+  python -m gubernator_tpu.cmd.cli debug    <http-addr>      # introspection
+
+`debug` pretty-prints the daemon's /v1/admin/debug snapshot (arena
+occupancy, admission queue, breaker states, congestion window, per-stage
+latency quantiles, recent traces).  `load --http-address` prints the same
+per-stage p50/p95/p99 table every 10 rounds while hammering.
 
 For compatibility, a bare address (no subcommand) runs load generation.
 """
@@ -28,7 +34,25 @@ import urllib.request
 from gubernator_tpu.api.types import Algorithm, RateLimitReq, Second, Status
 
 
-async def _load(address: str, count: int, concurrency: int) -> None:
+def _fetch_debug(http_address: str, timeout: float = 5.0) -> dict:
+    url = f"{_http_base(http_address)}/v1/admin/debug"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _print_stage_table(stages: dict) -> None:
+    if not stages:
+        print("stages: (no samples yet)")
+        return
+    print(f"{'stage':<18}{'count':>8}{'p50 ms':>10}{'p95 ms':>10}"
+          f"{'p99 ms':>10}")
+    for name, s in stages.items():
+        print(f"{name:<18}{s['count']:>8}{s['p50_ms']:>10.3f}"
+              f"{s['p95_ms']:>10.3f}{s['p99_ms']:>10.3f}")
+
+
+async def _load(address: str, count: int, concurrency: int,
+                http_address: str = "") -> None:
     from gubernator_tpu.client import AsyncClient, random_string
     client = AsyncClient(address)
     reqs = [
@@ -67,6 +91,16 @@ async def _load(address: str, count: int, concurrency: int) -> None:
         if rounds % 10 == 0:
             print("totals:", " ".join(
                 f"{k}={v}" for k, v in sorted(stats.items())))
+            if http_address:
+                # per-stage serving latency from the daemon's debug
+                # snapshot — where the round's time actually went
+                try:
+                    snap = await asyncio.to_thread(_fetch_debug,
+                                                   http_address)
+                    _print_stage_table(snap.get("stages", {}))
+                except Exception as e:
+                    print(f"(stage snapshot unavailable: {e})",
+                          file=sys.stderr)
 
 
 def _http_base(address: str) -> str:
@@ -104,10 +138,57 @@ def cmd_restore(args) -> int:
     return 0
 
 
+def cmd_debug(args) -> int:
+    try:
+        snap = _fetch_debug(args.address, timeout=args.timeout)
+    except Exception as e:
+        print(f"debug fetch failed: {e}", file=sys.stderr)
+        return 1
+    eng = snap.get("engine", {})
+    print(f"node {snap.get('address')} mesh_mode={snap.get('mesh_mode')} "
+          f"standalone={snap.get('standalone')}")
+    if eng:
+        print("engine:", " ".join(f"{k}={v}" for k, v in sorted(eng.items())))
+    adm = snap.get("admission")
+    if adm:
+        print(f"admission: pending={adm['pending']} "
+              f"peak={adm['pending_peak']}/{adm['max_pending']} "
+              f"saturated={adm['saturated']} sheds={adm['shed_counts']}")
+    cong = snap.get("congestion")
+    if cong:
+        print(f"congestion: window={cong['effective_window']} "
+              f"latency_ewma_ms={cong['latency_ewma_ms']:.2f} "
+              f"congested={cong['congested']} "
+              f"+{cong['increases']}/-{cong['decreases']}")
+    for peer in snap.get("peers", []):
+        print(f"peer {peer['host']}: breaker={peer['breaker']}"
+              f"{' (self)' if peer['is_owner'] else ''}")
+    pipe = snap.get("pipeline")
+    if pipe:
+        print("pipeline:", " ".join(
+            f"{k}={v}" for k, v in sorted(pipe.items())))
+    _print_stage_table(snap.get("stages", {}))
+    tracing = snap.get("tracing")
+    if tracing:
+        print(f"tracing: sample={tracing['sample']}")
+        for t in tracing.get("recent_traces", []):
+            print(f"  trace {t['trace_id'][:16]} root={t['root']} "
+                  f"spans={t['spans']} {t['duration_ms']:.2f}ms "
+                  f"slowest={t['slowest_span']} ({t['slowest_ms']:.2f}ms) "
+                  f"nodes={','.join(t['nodes'])}")
+    prof = snap.get("profile")
+    if prof:
+        print(f"profile: active={prof['active']} "
+              f"remaining={prof['remaining']} dir={prof['dir'] or '-'}")
+    if args.json:
+        print(json.dumps(snap, indent=2))
+    return 0
+
+
 def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     # compatibility: a bare address (or nothing) runs load generation
-    if not argv or argv[0] not in ("load", "snapshot", "restore"):
+    if not argv or argv[0] not in ("load", "snapshot", "restore", "debug"):
         argv.insert(0, "load")
 
     p = argparse.ArgumentParser("gubernator-tpu-cli")
@@ -117,6 +198,9 @@ def main(argv=None) -> None:
     pl.add_argument("address", nargs="?", default="127.0.0.1:9090")
     pl.add_argument("--count", type=int, default=2000)
     pl.add_argument("--concurrency", type=int, default=10)
+    pl.add_argument("--http-address", default="",
+                    help="daemon HTTP address; when set, print per-stage "
+                    "p50/p95/p99 from /v1/admin/debug every 10 rounds")
 
     ps = sub.add_parser("snapshot", help="pull a snapshot over HTTP admin")
     ps.add_argument("address", help="daemon HTTP address (host:port)")
@@ -133,13 +217,23 @@ def main(argv=None) -> None:
                     "REMAINING lifetime instead of absolute expiry")
     pr.add_argument("--timeout", type=float, default=30.0)
 
+    pd = sub.add_parser("debug", help="print the daemon's runtime "
+                        "introspection snapshot")
+    pd.add_argument("address", help="daemon HTTP address (host:port)")
+    pd.add_argument("--json", action="store_true",
+                    help="also dump the raw snapshot JSON")
+    pd.add_argument("--timeout", type=float, default=5.0)
+
     args = p.parse_args(argv)
     if args.cmd == "snapshot":
         sys.exit(cmd_snapshot(args))
     if args.cmd == "restore":
         sys.exit(cmd_restore(args))
+    if args.cmd == "debug":
+        sys.exit(cmd_debug(args))
     try:
-        asyncio.run(_load(args.address, args.count, args.concurrency))
+        asyncio.run(_load(args.address, args.count, args.concurrency,
+                          http_address=args.http_address))
     except KeyboardInterrupt:
         pass
 

@@ -150,6 +150,15 @@ class Config:
     qos: QoSConfig = field(default_factory=QoSConfig)
     # advertise address used for self-identification in the peer ring
     advertise_address: str = ""
+    # Request tracing (observability/tracing.py): probability a request
+    # starts a trace (0 disables, the default — the hot path pays one
+    # attribute check) and the optional OTLP/HTTP export endpoint.
+    # Defaults read the env at construction so library embedders get the
+    # same GUBER_TRACE_* knobs as the daemon.
+    trace_sample: float = field(
+        default_factory=lambda: env_float("GUBER_TRACE_SAMPLE", 0.0))
+    trace_export: str = field(
+        default_factory=lambda: _env("GUBER_TRACE_EXPORT"))
 
 
 @dataclass

@@ -36,10 +36,19 @@ class WindowBatcher:
         metrics=None,
         lockstep_clock=None,
         qos=None,
+        tracer=None,
     ):
         self.engine = engine
         self.behaviors = behaviors or BehaviorConfig()
         self.metrics = metrics
+        # observability/tracing.py Tracer or None; the pipeline shares it
+        # for per-request stage spans (sampled requests only)
+        self.tracer = tracer
+        # on-demand device capture (observability/introspect.py), armed by
+        # POST /v1/admin/profile; checked on the engine thread around each
+        # dispatch, so disarmed costs one integer compare
+        from gubernator_tpu.observability import ProfileCapture
+        self.profile = ProfileCapture()
         # QoSManager (gubernator_tpu/qos/) or None: admission control on
         # submit, congestion-adaptive window sizing, tenant-fair slotting.
         # None keeps every legacy code path byte-identical.
@@ -87,7 +96,8 @@ class WindowBatcher:
                              "lockstep_clock-driven WindowBatcher")
         self.pipeline: Optional[DispatchPipeline] = DispatchPipeline(
             engine, self._executor, metrics,
-            lockstep=lockstep_clock is not None, qos=qos)
+            lockstep=lockstep_clock is not None, qos=qos, tracer=tracer,
+            profile=self.profile)
         if not self.pipeline.enabled:
             self.pipeline = None
         elif self.pipeline.lockstep:
@@ -260,8 +270,19 @@ class WindowBatcher:
                     [[]], now, k_stack=self.behaviors.lockstep_stack)
             return self.engine.step([], now)
 
+        def run_profiled():
+            prof = self.profile
+            profiling = prof is not None and prof.armed
+            if profiling:
+                prof.before_drain()
+            try:
+                return run()
+            finally:
+                if profiling:
+                    prof.after_drain()
+
         try:
-            resps = await loop.run_in_executor(self._executor, run)
+            resps = await loop.run_in_executor(self._executor, run_profiled)
         except Exception as e:
             for w in windows:
                 for _, _, fut in w:
@@ -294,6 +315,10 @@ class WindowBatcher:
             self.metrics.window_count.inc()
             self.metrics.window_occupancy.observe(n_reqs)
             self.metrics.window_duration.observe(time.monotonic() - start)
+            # the legacy stacked step is dispatch-through-done in one call;
+            # stage decomposition attributes it all to device_dispatch
+            self.metrics.observe_stage("device_dispatch",
+                                       time.monotonic() - start)
         for w, rs in zip(windows, resps):
             for (_, _, fut), resp in zip(w, rs):
                 if not fut.done():
@@ -381,23 +406,35 @@ class WindowBatcher:
         accumulate = [w[1] for w in window]
         loop = asyncio.get_running_loop()
         start = time.monotonic()
+        def run():
+            prof = self.profile
+            profiling = prof is not None and prof.armed
+            if profiling:
+                prof.before_drain()
+            try:
+                now = self.now_fn() if self.now_fn is not None else None
+                return self.engine.process(reqs, now, accumulate)
+            finally:
+                if profiling:
+                    prof.after_drain()
+
         try:
-            now = self.now_fn() if self.now_fn is not None else None
-            resps = await loop.run_in_executor(
-                self._executor,
-                lambda: self.engine.process(reqs, now, accumulate)
-            )
+            resps = await loop.run_in_executor(self._executor, run)
         except Exception as e:  # resolve every waiter with the failure
             for _, _, fut in window:
                 if not fut.done():
                     fut.set_exception(e)
             return
+        wall = time.monotonic() - start
         if self.qos is not None:
-            self.qos.congestion.observe_drain(time.monotonic() - start)
+            self.qos.congestion.observe_drain(wall)
         if self.metrics is not None:
             self.metrics.window_count.inc()
             self.metrics.window_occupancy.observe(len(reqs))
-            self.metrics.window_duration.observe(time.monotonic() - start)
+            self.metrics.window_duration.observe(wall)
+            # legacy full-path window: one engine.process call covers
+            # dispatch through fetch; attributed to device_dispatch
+            self.metrics.observe_stage("device_dispatch", wall)
         for (_, _, fut), resp in zip(window, resps):
             if not fut.done():
                 fut.set_result(resp)

@@ -27,6 +27,7 @@ from typing import Dict, Optional
 from gubernator_tpu.api.types import RateLimitReq, UpdatePeerGlobal
 from gubernator_tpu.config import BehaviorConfig
 from gubernator_tpu.core.interval import ArmedInterval
+from gubernator_tpu.observability.tracing import NOOP_SPAN
 
 
 class GlobalManager:
@@ -134,6 +135,22 @@ class GlobalManager:
     async def _broadcast(self) -> None:
         updates, self._updates = self._updates, {}
         start = time.monotonic()
+        # the broadcast runs on its own timer task, so it roots its own
+        # trace (there is no single originating request to stitch into)
+        tracer = getattr(self.instance, "tracer", None)
+        span = (tracer.start_trace("global_broadcast")
+                if tracer is not None and tracer.enabled else NOOP_SPAN)
+        try:
+            with span:
+                await self._broadcast_inner(updates)
+        finally:
+            wall = time.monotonic() - start
+            if self.metrics is not None:
+                self.metrics.broadcast_durations.observe(wall)
+                self.metrics.observe_stage("global_broadcast", wall)
+
+    async def _broadcast_inner(self, updates: Dict[str, RateLimitReq]
+                               ) -> None:
         globals_ = []
         for key, req in updates.items():
             # authoritative status: re-read with behavior/hits cleared
@@ -160,5 +177,3 @@ class GlobalManager:
                     self.log.error("error sending global updates to '%s': %s",
                                    peer.host, e)
                 continue
-        if self.metrics is not None:
-            self.metrics.broadcast_durations.observe(time.monotonic() - start)

@@ -63,6 +63,7 @@ from gubernator_tpu.api.types import (
 )
 from gubernator_tpu.config import MAX_BATCH_SIZE
 from gubernator_tpu.core.engine import PIPELINE_K_BUCKETS
+from gubernator_tpu.observability.tracing import current_context
 from gubernator_tpu.ops import kernel
 from gubernator_tpu.qos import interleave_by_tenant
 
@@ -173,13 +174,18 @@ class RpcJob:
     is local, like the reference owner (gubernator.go:210-227)."""
 
     __slots__ = ("data", "fut", "n", "row", "lane", "pos", "limit", "off",
-                 "mlen", "remote_idx", "forward_task", "peer_mode")
+                 "mlen", "remote_idx", "forward_task", "peer_mode",
+                 "ctx", "enq")
 
     def __init__(self, data: bytes, fut: asyncio.Future,
                  peer_mode: bool = False):
         self.data = data
         self.fut = fut
         self.peer_mode = peer_mode
+        # trace context + enqueue stamp (observability): the sampled
+        # SpanContext this RPC rode in on, and when it joined the queue
+        self.ctx = None
+        self.enq = 0.0
         self.n = 0
         self.row = None
         self.lane = None
@@ -214,14 +220,20 @@ class ListJob:
     packed columnar through the same stack.  Resolves each request's future
     (singles) or one future with the response list (batch)."""
 
-    __slots__ = ("reqs", "futs", "fut", "row", "lane", "pos", "n", "_cols")
+    __slots__ = ("reqs", "futs", "fut", "row", "lane", "pos", "n", "_cols",
+                 "ctxs", "enq")
 
     def __init__(self, reqs: Sequence[RateLimitReq],
                  futs: Optional[List[asyncio.Future]] = None,
-                 fut: Optional[asyncio.Future] = None):
+                 fut: Optional[asyncio.Future] = None,
+                 ctxs: Optional[List] = None, enq: float = 0.0):
         self.reqs = list(reqs)
         self.futs = futs
         self.fut = fut
+        # sampled SpanContexts riding this job (aligned with reqs for
+        # singles chunks, single-element for batch jobs) + oldest enqueue
+        self.ctxs = ctxs
+        self.enq = enq
         self.n = len(self.reqs)
         self.row = None
         self.lane = None
@@ -308,7 +320,9 @@ class _GlobalJob:
 class _DrainResult:
     __slots__ = ("words", "limits", "mism", "gfused", "staged", "fallback",
                  "leftover", "now", "n_decisions", "n_lanes", "k_used",
-                 "error", "started", "ring_peers")
+                 "error", "started", "ring_peers",
+                 "pack_done", "dispatch_done", "fetch_start", "fetch_done",
+                 "oldest_enq")
 
     def __init__(self):
         self.words = None
@@ -325,6 +339,15 @@ class _DrainResult:
         self.error = None
         self.started = 0.0
         self.ring_peers = ()
+        # stage boundaries (monotonic): window_fill = started→pack_done,
+        # device_dispatch = pack_done→dispatch_done, drain_commit =
+        # fetch_start→fetch_done; admission_wait = oldest_enq→started.
+        # 0.0 = the boundary was never reached (error paths observe nothing)
+        self.pack_done = 0.0
+        self.dispatch_done = 0.0
+        self.fetch_start = 0.0
+        self.fetch_done = 0.0
+        self.oldest_enq = 0.0
 
 
 class DispatchPipeline:
@@ -340,8 +363,12 @@ class DispatchPipeline:
     def __init__(self, engine, engine_executor: ThreadPoolExecutor,
                  metrics=None, k_max: int = PIPELINE_K_BUCKETS[-1],
                  depth: int = 3, lockstep: Optional[bool] = None,
-                 qos=None):
+                 qos=None, tracer=None, profile=None):
         self.engine = engine
+        # observability: span recorder (None = tracing off everywhere) and
+        # the armable jax.profiler capture shared with the batcher
+        self.tracer = tracer
+        self.profile = profile
         # QoSManager or None: feeds the AIMD from observed drain wall time
         # and caps decisions-per-drain + in-flight depth by the congestion
         # window (None = legacy static behavior, used by existing tests)
@@ -461,13 +488,24 @@ class DispatchPipeline:
             return None
         self._loop = asyncio.get_running_loop()
         fut = self._loop.create_future()
-        self._jobs.append(RpcJob(data, fut, peer_mode=peer_mode))
+        job = RpcJob(data, fut, peer_mode=peer_mode)
+        job.enq = time.monotonic()
+        job.ctx = current_context()
+        if self.tracer is not None and job.ctx is not None:
+            job.ctx.enqueued_at = job.enq
+            self.tracer.record_span(job.ctx, "enqueue", job.enq, job.enq)
+        self._jobs.append(job)
         self._pump()
         return await fut
 
     async def submit_one(self, req: RateLimitReq) -> RateLimitResp:
         self._loop = asyncio.get_running_loop()
         fut = self._loop.create_future()
+        t_enq = time.monotonic()
+        ctx = current_context()
+        if self.tracer is not None and ctx is not None:
+            ctx.enqueued_at = t_enq
+            self.tracer.record_span(ctx, "enqueue", t_enq, t_enq)
         if req.behavior == Behavior.GLOBAL:
             # only reachable through eligible_global (lockstep mode):
             # GLOBAL singles keep their own queue so regular ListJobs
@@ -475,7 +513,7 @@ class DispatchPipeline:
             # GLOBAL lanes spread round-robin instead)
             self._gsingles.append((req, fut))
         else:
-            self._singles.append((req, fut))
+            self._singles.append((req, fut, t_enq, ctx))
         self._pump()
         return await fut
 
@@ -483,7 +521,10 @@ class DispatchPipeline:
                           ) -> List[RateLimitResp]:
         self._loop = asyncio.get_running_loop()
         fut = self._loop.create_future()
-        self._jobs.append(ListJob(reqs, fut=fut))
+        ctx = current_context()
+        self._jobs.append(ListJob(reqs, fut=fut,
+                                  ctxs=[ctx] if ctx is not None else None,
+                                  enq=time.monotonic()))
         self._pump()
         return await fut
 
@@ -550,8 +591,10 @@ class DispatchPipeline:
                                               singles[budget:])
             for base in range(0, len(singles), MAX_BATCH_SIZE):
                 chunk = singles[base:base + MAX_BATCH_SIZE]
-                jobs.append(ListJob([r for r, _ in chunk],
-                                    futs=[f for _, f in chunk]))
+                jobs.append(ListJob([t[0] for t in chunk],
+                                    futs=[t[1] for t in chunk],
+                                    ctxs=[t[3] for t in chunk],
+                                    enq=min(t[2] for t in chunk)))
         jobs.extend(self._jobs)
         self._jobs = []
         return jobs
@@ -778,24 +821,62 @@ class DispatchPipeline:
             else:
                 if not job.fut.done():
                     job.fut.set_result(out)
+        # ONE clock for control and observability: the drain wall time is
+        # the traced stage boundary (started→fetch_done), so the AIMD's
+        # EWMA and the guber_tpu_stage_duration_ms histograms read the
+        # same number for the same drain
+        drain_wall = (res.fetch_done or time.monotonic()) - res.started
         if self.qos is not None and res.n_decisions:
-            # the AIMD's congestion signal: wall time from drain start
-            # through fetch+demux, weighted by occupied window depth
             self.qos.congestion.observe_drain(
-                time.monotonic() - res.started, depth=max(1, res.k_used))
+                drain_wall, depth=max(1, res.k_used))
         if self.metrics is not None:
-            self.metrics.window_count.inc()
-            self.metrics.window_occupancy.observe(res.n_decisions)
-            self.metrics.window_duration.observe(
-                time.monotonic() - res.started)
-            self.metrics.agg_decisions.inc(res.n_decisions)
-            self.metrics.agg_lanes.inc(res.n_lanes)
+            m = self.metrics
+            m.window_count.inc()
+            m.window_occupancy.observe(res.n_decisions)
+            m.window_duration.observe(drain_wall)
+            m.agg_decisions.inc(res.n_decisions)
+            m.agg_lanes.inc(res.n_lanes)
             # fused-path adoption + per-drain window depth (ISSUE 2
             # observability): how deep the stacks actually run, and whether
             # the drains lower to the fused megakernel
-            self.metrics.drain_depth.observe(res.k_used)
+            m.drain_depth.observe(res.k_used)
             if self.fused_serving:
-                self.metrics.fused_drains.inc()
+                m.fused_drains.inc()
+            # stage-latency decomposition from the drain's boundary stamps
+            # (0.0 boundary = never reached, e.g. an idle lockstep tick)
+            if res.oldest_enq:
+                m.observe_stage("admission_wait", res.started - res.oldest_enq)
+            if res.pack_done:
+                m.observe_stage("window_fill", res.pack_done - res.started)
+            if res.dispatch_done and res.pack_done:
+                m.observe_stage("device_dispatch",
+                                res.dispatch_done - res.pack_done)
+            if res.fetch_done and res.fetch_start:
+                m.observe_stage("drain_commit",
+                                res.fetch_done - res.fetch_start)
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            ctxs = set()
+            for job in res.staged:
+                c = getattr(job, "ctx", None)
+                if c is not None:
+                    ctxs.add(c)
+                for c in (getattr(job, "ctxs", None) or ()):
+                    if c is not None:
+                        ctxs.add(c)
+            for c in ctxs:
+                if c.enqueued_at:
+                    tr.record_span(c, "admission_wait", c.enqueued_at,
+                                   res.started)
+                if res.pack_done:
+                    tr.record_span(c, "window_fill", res.started,
+                                   res.pack_done)
+                if res.dispatch_done and res.pack_done:
+                    tr.record_span(c, "device_dispatch", res.pack_done,
+                                   res.dispatch_done)
+                if res.fetch_done and res.fetch_start:
+                    tr.record_span(c, "drain_commit", res.fetch_start,
+                                   res.fetch_done)
         self._pump(force=True)
 
     async def _assemble_mixed(self, job: RpcJob, local_parts, now) -> None:
@@ -864,6 +945,24 @@ class DispatchPipeline:
     def _drain_sync(self, jobs: List[object], now: Optional[int] = None,
                     k_fixed: Optional[int] = None,
                     gjob: Optional[_GlobalJob] = None) -> _DrainResult:
+        """Engine-thread drain entry: wraps the real drain in the armed
+        jax.profiler capture when POST /v1/admin/profile requested one
+        (plain int read when disarmed — the hot path pays nothing)."""
+        prof = self.profile
+        if prof is not None and prof.armed:
+            prof.before_drain()
+            try:
+                return self._drain_sync_inner(jobs, now=now,
+                                              k_fixed=k_fixed, gjob=gjob)
+            finally:
+                prof.after_drain()
+        return self._drain_sync_inner(jobs, now=now, k_fixed=k_fixed,
+                                      gjob=gjob)
+
+    def _drain_sync_inner(self, jobs: List[object],
+                          now: Optional[int] = None,
+                          k_fixed: Optional[int] = None,
+                          gjob: Optional[_GlobalJob] = None) -> _DrainResult:
         """Pack every job into one stacked compact dispatch (engine thread).
 
         Fresh numpy staging per drain: the previous drain's arrays may still
@@ -941,6 +1040,9 @@ class DispatchPipeline:
                 else:
                     res.fallback.append(job)
 
+        res.pack_done = time.monotonic()
+        enqs = [e for e in (getattr(j, "enq", 0.0) for j in res.staged) if e]
+        res.oldest_enq = min(enqs) if enqs else 0.0
         if not res.staged and gjob is None and not self.lockstep:
             return res
         k_used = int(fills.any(axis=1).sum())
@@ -1060,6 +1162,7 @@ class DispatchPipeline:
             res.words, res.limits, res.mism = words, limits, mism
         else:
             native.commit()  # nothing staged: empty by construction
+        res.dispatch_done = time.monotonic()
         # forwarded items are the OWNER's decisions, not ours — counting
         # them here would double-count cluster-wide (the owner's peer-lane
         # drain counts them)
@@ -1079,6 +1182,7 @@ class DispatchPipeline:
     # ------------------------------------------------------------ fetch side
 
     def _complete_sync(self, res: _DrainResult):
+        res.fetch_start = time.monotonic()
         eng = self.engine
         B = eng.batch_per_shard
         if res.words is None:  # all-forwarded drain: nothing was dispatched
@@ -1104,6 +1208,7 @@ class DispatchPipeline:
         outs = [job.finish_global(gflat) if isinstance(job, _GlobalJob)
                 else job.finish(self, wflat, clflat, res.now)
                 for job in res.staged]
+        res.fetch_done = time.monotonic()
         return res, outs
 
     def close(self) -> None:
@@ -1121,9 +1226,9 @@ class DispatchPipeline:
         gsingles, self._gsingles = self._gsingles, []
         for job in jobs:
             self._resolve_error(job, err)
-        for _, f in singles:
-            if not f.done():
-                f.set_exception(err)
+        for entry in singles:
+            if not entry[1].done():
+                entry[1].set_exception(err)
         for _, f in gsingles:
             if not f.done():
                 f.set_exception(err)

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Dict, List, Optional, Sequence
 
 from gubernator_tpu.api.types import (
@@ -32,9 +33,8 @@ from gubernator_tpu.core.batcher import WindowBatcher
 from gubernator_tpu.core.engine import RateLimitEngine
 from gubernator_tpu.core.global_sync import GlobalManager
 from gubernator_tpu.net.peers import BreakerOpenError, PeerClient
-from gubernator_tpu.parallel.router import MeshShardPicker
-from gubernator_tpu.observability.metrics import Metrics
-from gubernator_tpu.parallel.router import ConsistentHashRing
+from gubernator_tpu.observability import Metrics, Tracer
+from gubernator_tpu.parallel.router import ConsistentHashRing, MeshShardPicker
 from gubernator_tpu.qos import QoSManager, shed_response
 from gubernator_tpu.qos.admission import SHED_BREAKER_OPEN
 
@@ -56,6 +56,7 @@ class Instance:
         engine: Optional[RateLimitEngine] = None,
         metrics: Optional[Metrics] = None,
         mesh_peers: Optional[List[str]] = None,
+        tracer: Optional[Tracer] = None,
     ):
         """mesh_peers: gRPC addresses of every mesh process in PROCESS-RANK
         order — enables mesh serving mode (parallel/distributed.py): shard-
@@ -64,6 +65,13 @@ class Instance:
         self.conf = config or Config()
         self.conf.behaviors.validate()
         self.metrics = metrics or Metrics()
+        # per-instance span recorder, like the Metrics registry — each
+        # node's ring buffer is its own, so a stitched trace is assembled
+        # by trace id across nodes (tests: tests/test_tracing.py)
+        self.tracer = tracer if tracer is not None else Tracer(
+            sample=self.conf.trace_sample,
+            export=self.conf.trace_export or None,
+            node=self.conf.advertise_address or "local")
         e = self.conf.engine
         self.engine = engine or RateLimitEngine(
             mesh=mesh,
@@ -96,7 +104,7 @@ class Instance:
                                   self.conf.behaviors.batch_wait)
         self.batcher = WindowBatcher(self.engine, self.conf.behaviors,
                                      self.metrics, lockstep_clock=clock,
-                                     qos=self.qos)
+                                     qos=self.qos, tracer=self.tracer)
         self.global_mgr = GlobalManager(
             self.conf.behaviors, self, self.metrics, log)
         if self.mesh_mode:
@@ -199,13 +207,22 @@ class Instance:
                 return RateLimitResp(
                     error=f"while applying rate limit for '{key}' - '{e}'")
 
+        # the forward hop is traced (peer_forward) AND staged: the span
+        # carries the traceparent to the owner through the peer lane's
+        # gRPC metadata (net/peers.py), so the owner's peer_rpc span lands
+        # in the same trace — one stitched view of the cross-node hit
+        t0 = time.monotonic()
         try:
-            resp = await peer.get_peer_rate_limit(r)
+            with self.tracer.span("peer_forward") as span:
+                span.set_attr("peer", peer.host)
+                resp = await peer.get_peer_rate_limit(r)
         except BreakerOpenError:
             return await self._breaker_fallback(r, peer.host, deadline)
         except Exception as e:
             return RateLimitResp(
                 error=f"while fetching rate limit '{key}' from peer - '{e}'")
+        finally:
+            self.metrics.observe_stage("peer_forward", time.monotonic() - t0)
         # tell the client who coordinates this key (gubernator.go:151)
         resp.metadata = dict(resp.metadata or {}, owner=peer.host)
         return resp

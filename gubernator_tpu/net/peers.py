@@ -23,6 +23,7 @@ from gubernator_tpu.api.grpc_api import PeersV1Stub
 from gubernator_tpu.api.types import Behavior, RateLimitReq, RateLimitResp
 from gubernator_tpu.config import BehaviorConfig, QoSConfig
 from gubernator_tpu.core.interval import ArmedInterval
+from gubernator_tpu.observability.tracing import TRACEPARENT, current_context
 from gubernator_tpu.qos.breaker import CircuitBreaker, backoff_delays
 
 log = logging.getLogger("gubernator.peers")
@@ -74,7 +75,7 @@ class PeerClient:
         self.stub = PeersV1Stub(self.channel)
         self._raw_batch = None  # bytes-level relay, built on first use
         self._raw_transfer = None  # bytes-level bucket-migration lane
-        self._pending: List[tuple] = []  # (req, future)
+        self._pending: List[tuple] = []  # (req, future, trace ctx|None)
         self._interval: Optional[ArmedInterval] = None
         self._waiter: Optional[asyncio.Task] = None
         # ---- resilience (gubernator_tpu/qos/breaker.py)
@@ -159,11 +160,19 @@ class PeerClient:
         resps = await self.get_peer_rate_limits([req])
         return resps[0]
 
-    async def get_peer_rate_limits(self, reqs: List[RateLimitReq]) -> List[RateLimitResp]:
-        """One unary batch RPC; validates response length (peers.go:93-105)."""
+    async def get_peer_rate_limits(self, reqs: List[RateLimitReq],
+                                   ctx=None) -> List[RateLimitResp]:
+        """One unary batch RPC; validates response length (peers.go:93-105).
+
+        `ctx` (or the ambient sampled SpanContext) rides the RPC as
+        `traceparent` invocation metadata so the owner's spans stitch into
+        the caller's trace."""
+        if ctx is None:
+            ctx = current_context()
+        md = ((TRACEPARENT, ctx.traceparent()),) if ctx is not None else None
         msg = pb.GetPeerRateLimitsReq(requests=[pb.req_to_pb(r) for r in reqs])
         resp = await self._call(lambda: self.stub.GetPeerRateLimits(
-            msg, timeout=self.conf.batch_timeout))
+            msg, timeout=self.conf.batch_timeout, metadata=md))
         if len(resp.rate_limits) != len(reqs):
             raise RuntimeError(
                 "number of rate limits in peer response does not match request")
@@ -233,7 +242,9 @@ class PeerClient:
 
     async def _batched(self, req: RateLimitReq) -> RateLimitResp:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending.append((req, fut))
+        # capture the ambient trace context NOW — the flusher task that
+        # ships the window has no ambient ctx of its own
+        self._pending.append((req, fut, current_context()))
         if len(self._pending) >= self.conf.batch_limit:
             self._flush()
         elif len(self._pending) == 1:
@@ -256,18 +267,21 @@ class PeerClient:
 
     async def _send_window(self, window: List[tuple]) -> None:
         reqs = [w[0] for w in window]
+        # the window carries many requests but one RPC: propagate the first
+        # sampled context (a shared-batch trace is stitched, not per-item)
+        ctx = next((w[2] for w in window if w[2] is not None), None)
         try:
-            resps = await self.get_peer_rate_limits(reqs)
+            resps = await self.get_peer_rate_limits(reqs, ctx=ctx)
         except Exception as e:
             # the whole batch failed; every waiter sees the error
             # (peers.go:189-196)
-            for _, fut in window:
-                if not fut.done():
-                    fut.set_exception(e)
+            for w in window:
+                if not w[1].done():
+                    w[1].set_exception(e)
             return
-        for (_, fut), resp in zip(window, resps):
-            if not fut.done():
-                fut.set_result(resp)
+        for w, resp in zip(window, resps):
+            if not w[1].done():
+                w[1].set_result(resp)
 
     async def close(self) -> None:
         """Disconnect (the reference leaks old PeerClients on membership

@@ -1,0 +1,43 @@
+"""Observability subsystem: metrics, tracing, and runtime introspection.
+
+Public API — import from here, not the submodules:
+
+  * `Metrics` — per-instance Prometheus registry with the reference's
+    metric names (metrics.py);
+  * `Tracer` / `get_tracer` — the lightweight span recorder and the
+    process-default instance configured from GUBER_TRACE_* (tracing.py);
+  * `ProfileCapture` / `build_debug_snapshot` — on-demand device capture
+    and the `/v1/admin/debug` operator view (introspect.py).
+"""
+
+from gubernator_tpu.observability.introspect import (
+    ProfileCapture,
+    build_debug_snapshot,
+)
+from gubernator_tpu.observability.metrics import (
+    CONTENT_TYPE_LATEST,
+    STAGES,
+    Metrics,
+)
+from gubernator_tpu.observability.tracing import (
+    NOOP_SPAN,
+    SpanContext,
+    Tracer,
+    current_context,
+    get_tracer,
+    parse_traceparent,
+)
+
+__all__ = [
+    "CONTENT_TYPE_LATEST",
+    "Metrics",
+    "NOOP_SPAN",
+    "ProfileCapture",
+    "STAGES",
+    "SpanContext",
+    "Tracer",
+    "build_debug_snapshot",
+    "current_context",
+    "get_tracer",
+    "parse_traceparent",
+]

@@ -12,8 +12,10 @@ occupancy, device step duration.
 
 from __future__ import annotations
 
+import math
+import threading
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 from prometheus_client import (
     CollectorRegistry,
@@ -22,7 +24,64 @@ from prometheus_client import (
     Histogram,
     generate_latest,
 )
-from prometheus_client import CONTENT_TYPE_LATEST  # noqa: F401
+from prometheus_client import CONTENT_TYPE_LATEST
+
+# Canonical stage names of the request lifecycle, in pipeline order.
+# observability/tracing.py spans, the stage histograms, and the debug
+# snapshot all use exactly these labels so dashboards, traces, and the
+# `cli debug` table line up column-for-column.
+STAGES = (
+    "enqueue",          # submit -> appended to the pending window
+    "admission_wait",   # time queued before a dispatch takes the request
+    "window_fill",      # host-side window build (pack keys, stage cols)
+    "device_dispatch",  # engine thread: device step launch through done
+    "drain_commit",     # fetch thread: device->host readback + replies
+    "peer_forward",     # non-owner hop: peer-lane RPC round trip
+    "global_broadcast", # GLOBAL lane: owner's broadcast to all peers
+)
+
+
+class _StageRing:
+    """Fixed-size ring of recent stage durations (seconds) behind one
+    lock — the rolling-window source for the p50/p95/p99 snapshot.  A
+    Prometheus histogram alone can't answer "p99 over the last minute"
+    without a scraping sidecar; the ring keeps the last `size` samples so
+    the debug endpoint and `cli load` read live quantiles in-process."""
+
+    __slots__ = ("_buf", "_size", "_idx", "_count", "_lock")
+
+    def __init__(self, size: int = 1024):
+        self._buf = [0.0] * size
+        self._size = size
+        self._idx = 0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._buf[self._idx] = seconds
+            self._idx = (self._idx + 1) % self._size
+            if self._count < self._size:
+                self._count += 1
+
+    def snapshot(self) -> Optional[dict]:
+        with self._lock:
+            n = self._count
+            if n == 0:
+                return None
+            samples = sorted(self._buf[:n] if n < self._size
+                             else list(self._buf))
+
+        def pct(p: float) -> float:
+            return samples[min(n - 1, int(math.ceil(p * n)) - 1)] * 1000.0
+
+        return {
+            "count": n,
+            "p50_ms": pct(0.50),
+            "p95_ms": pct(0.95),
+            "p99_ms": pct(0.99),
+            "mean_ms": sum(samples) / n * 1000.0,
+        }
 
 
 class Metrics:
@@ -198,6 +257,20 @@ class Metrics:
             "owner's breaker was open.",
             registry=self.registry,
         )
+        # stage-latency decomposition (observability/tracing.py records the
+        # same boundaries as spans): per-stage wall time at window/drain
+        # granularity, always on — a few µs per window, amortized over up
+        # to 1000 decisions
+        self.stage_duration = Histogram(
+            "guber_tpu_stage_duration_ms",
+            "Wall time of one request-lifecycle stage in milliseconds.",
+            ["stage"],
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100,
+                     250, 500, 1000, 2500),
+            registry=self.registry,
+        )
+        self._stage_rings: Dict[str, _StageRing] = {}
+        self._stage_rings_lock = threading.Lock()
 
     def add_scrape_hook(self, fn) -> None:
         """Register a callable run before every expose() — the analog of the
@@ -270,6 +343,38 @@ class Metrics:
             self.migrated_keys.labels(direction="in").inc(imported)
         if skipped_stale:
             self.migration_skipped_stale.inc(skipped_stale)
+
+    def observe_stage(self, stage: str, seconds: float) -> None:
+        """Record one stage duration into both the Prometheus histogram
+        (milliseconds, for dashboards) and the in-process ring (for the
+        rolling p50/p95/p99 snapshot)."""
+        if seconds < 0.0:
+            seconds = 0.0
+        self.stage_duration.labels(stage=stage).observe(seconds * 1000.0)
+        ring = self._stage_rings.get(stage)
+        if ring is None:
+            with self._stage_rings_lock:
+                ring = self._stage_rings.setdefault(stage, _StageRing())
+        ring.observe(seconds)
+
+    def stage_snapshot(self) -> Dict[str, dict]:
+        """Rolling per-stage quantiles, `engine.cache_stats`-style: one
+        coherent read of every stage ring, keyed by stage name in
+        pipeline order (stages with no samples yet are omitted)."""
+        out: Dict[str, dict] = {}
+        with self._stage_rings_lock:
+            rings = dict(self._stage_rings)
+        for stage in STAGES:
+            ring = rings.pop(stage, None)
+            if ring is not None:
+                snap = ring.snapshot()
+                if snap is not None:
+                    out[stage] = snap
+        for stage, ring in rings.items():  # non-canonical stages last
+            snap = ring.snapshot()
+            if snap is not None:
+                out[stage] = snap
+        return out
 
     def expose(self) -> bytes:
         for fn in self._scrape_hooks:

@@ -49,7 +49,12 @@ class CongestionController:
     def observe_drain(self, wall_seconds: float, depth: int = 1) -> None:
         """Feed one completed drain cycle (engine dispatch through fetch).
         `depth` is the occupied window depth K of the drain (EWMA'd for
-        the metrics surface and the wait estimator)."""
+        the metrics surface and the wait estimator).
+
+        `wall_seconds` is the pipeline's traced drain boundary
+        (started→fetch_done, core/pipeline.py _on_completed) — the SAME
+        value observed into guber_tpu_window_duration_* and the stage
+        timeline, so the controller and the dashboards read one clock."""
         a = self.alpha
         if not self._observed:
             self.latency_ewma = wall_seconds

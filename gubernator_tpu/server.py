@@ -16,6 +16,7 @@ import grpc
 from gubernator_tpu.api import pb
 from gubernator_tpu.api.grpc_api import add_peers_servicer, add_v1_servicer
 from gubernator_tpu.core.service import BatchTooLargeError, Instance
+from gubernator_tpu.observability.tracing import TRACEPARENT
 
 # Only RPCs at least this large take the native pipeline RPC lane; smaller
 # ones go through the per-item path, whose requests aggregate with
@@ -25,11 +26,30 @@ from gubernator_tpu.core.service import BatchTooLargeError, Instance
 FASTPATH_MIN_BYTES = 2048
 
 
+def _traceparent_from(context) -> Optional[str]:
+    """The caller's `traceparent` invocation-metadata entry, if any (the
+    gRPC leg of W3C trace propagation — net/peers.py sets it)."""
+    try:
+        for k, v in context.invocation_metadata() or ():
+            if k == TRACEPARENT:
+                return v
+    except Exception:
+        return None
+    return None
+
+
 class _V1Servicer:
     def __init__(self, instance: Instance):
         self.instance = instance
 
     async def GetRateLimits(self, data: bytes, context):
+        tracer = self.instance.tracer
+        if tracer is None or not tracer.enabled:
+            return await self._get_rate_limits(data, context)
+        with tracer.start_trace("rpc", _traceparent_from(context)):
+            return await self._get_rate_limits(data, context)
+
+    async def _get_rate_limits(self, data: bytes, context):
         inst = self.instance
         m = inst.metrics
         start = time.monotonic()
@@ -93,6 +113,16 @@ class _PeersServicer:
         self.instance = instance
 
     async def GetPeerRateLimits(self, data: bytes, context):
+        # owner-side root of a forwarded request: the traceparent metadata
+        # the forwarding node attached stitches this node's spans into the
+        # SAME trace (one trace across owner and non-owner)
+        tracer = self.instance.tracer
+        if tracer is None or not tracer.enabled:
+            return await self._get_peer_rate_limits(data, context)
+        with tracer.start_trace("peer_rpc", _traceparent_from(context)):
+            return await self._get_peer_rate_limits(data, context)
+
+    async def _get_peer_rate_limits(self, data: bytes, context):
         inst = self.instance
         m = inst.metrics
         start = time.monotonic()

@@ -1,0 +1,76 @@
+"""Measure the tracing instrumentation's cost on the serving drain.
+
+Two sweeps over the same in-process Instance (CPU or chip, whatever JAX
+finds): batched single-key submits through the full pipeline drain with
+
+  (a) tracing OFF  (sample=0.0, the default) — the hot path should pay
+      one attribute check per request; and
+  (b) tracing ON   (sample=1.0) — every request records its full span
+      set (enqueue, admission_wait, window_fill, device_dispatch,
+      drain_commit).
+
+Prints decisions/s for both and the relative overhead.  The acceptance
+bar is <5% for the OFF case relative to the median of its own warm
+rounds (i.e. the disabled-path cost is noise), and the ON case is
+reported for the record — sampling at 1.0 is a debugging posture, not a
+production one.
+"""
+import asyncio
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from gubernator_tpu.api.types import Algorithm, RateLimitReq, Second
+from gubernator_tpu.config import Config, EngineConfig
+from gubernator_tpu.core.service import Instance
+
+N_KEYS = int(os.environ.get("GUBER_PROBE_KEYS", "512"))
+ROUNDS = int(os.environ.get("GUBER_PROBE_ROUNDS", "30"))
+WARMUP = 5
+
+
+def make_reqs():
+    return [
+        RateLimitReq(name="probe", unique_key=f"k{i}", hits=1,
+                     limit=1 << 20, duration=Second,
+                     algorithm=Algorithm.TOKEN_BUCKET)
+        for i in range(N_KEYS)
+    ]
+
+
+async def sweep(sample: float) -> float:
+    conf = Config(engine=EngineConfig(capacity_per_shard=4096,
+                                      batch_per_shard=1024))
+    conf.trace_sample = sample
+    inst = Instance(conf)
+    inst.engine.warmup()
+    reqs = make_reqs()
+    rates = []
+    try:
+        for r in range(ROUNDS):
+            t0 = time.monotonic()
+            await inst.get_rate_limits(reqs)
+            dt = time.monotonic() - t0
+            if r >= WARMUP:
+                rates.append(N_KEYS / dt)
+    finally:
+        inst.close()
+    return statistics.median(rates)
+
+
+async def main():
+    off = await sweep(0.0)
+    on = await sweep(1.0)
+    overhead = (off - on) / off * 100.0
+    print(f"tracing off: {off:,.0f} decisions/s")
+    print(f"tracing on (sample=1.0): {on:,.0f} decisions/s")
+    print(f"sampled-vs-off overhead: {overhead:+.1f}%")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
