@@ -1,7 +1,7 @@
 # Dev targets (the reference Makefile:1-15 has only release/docker; we add
 # the working set).
 
-.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos test-obs proto bench docker lint cluster
+.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos test-obs test-chaos proto bench docker lint cluster
 
 test:
 	python -m pytest tests/ -x -q
@@ -38,6 +38,13 @@ test-qos:
 # tier-1 (`test-core` picks it up too); this target runs just the slice.
 test-obs:
 	python -m pytest tests/ -x -q -m "obs and not slow"
+
+# the self-healing slice: heartbeat failure detection + ring re-home,
+# hinted handoff of GLOBAL payloads, graceful drain, deterministic fault
+# injection.  Part of tier-1 (`test-core` picks it up too); this target
+# runs just the slice.
+test-chaos:
+	python -m pytest tests/ -x -q -m "chaos and not slow"
 
 proto:
 	cd gubernator_tpu/api/proto && protoc --python_out=. gubernator.proto peers.proto

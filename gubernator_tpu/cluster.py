@@ -13,6 +13,7 @@ aggregation, broadcasts) over real gRPC.
 
 from __future__ import annotations
 
+import logging
 import random
 from dataclasses import replace
 from typing import List, Optional, Sequence
@@ -20,6 +21,8 @@ from typing import List, Optional, Sequence
 from gubernator_tpu.config import BehaviorConfig, Config, EngineConfig, PeerInfo
 from gubernator_tpu.core.service import Instance
 from gubernator_tpu.server import GrpcServer
+
+log = logging.getLogger("gubernator.cluster")
 
 
 class ClusterNode:
@@ -93,23 +96,59 @@ class Cluster:
         """Shrink the ring: the departing node first ships EVERY key it
         owns to the surviving membership (its migrate_keys diff is old
         membership -> membership-without-self, so all its keys re-home),
-        then leaves the ring and stops."""
+        then leaves the ring and stops.  A failed handoff must NOT leave
+        the survivors' rings still naming the departed node — they get
+        rewired (keys restart cold) no matter what the migration did."""
         node = self.nodes[idx]
         old_hosts = self.addresses
         new_hosts = [a for a in old_hosts if a != node.address]
-        # departing node still has the OLD ring installed, so its picker
-        # can reach every destination peer while it drains itself
-        await node.instance.migrate_keys(old_hosts, new_hosts)
+        try:
+            # departing node still has the OLD ring installed, so its picker
+            # can reach every destination peer while it drains itself
+            await node.instance.migrate_keys(old_hosts, new_hosts)
+        except Exception:
+            log.exception("departing node %s failed its handoff; its keys "
+                          "restart cold on the survivors", node.address)
         self.nodes.pop(idx)
         await self._rewire()
         await node.server.stop()
         node.instance.close()
 
+    async def kill_instance(self, idx: int) -> ClusterNode:
+        """CRASH a node: stop its server and engine with NO handoff and NO
+        rewire — the survivors' rings still name it, exactly like a real
+        peer death.  Recovery is the failure detector's job (net/health.py).
+        Returns the removed node so chaos tests can assert against it."""
+        node = self.nodes.pop(idx)
+        try:
+            await node.server.stop(grace=0.0)
+        except Exception:
+            log.exception("killing %s: server stop failed", node.address)
+        try:
+            node.instance.close()
+        except Exception:
+            log.exception("killing %s: instance close failed", node.address)
+        return node
+
     async def stop(self) -> None:
+        """Stop every node, tolerating per-node failures: one failing
+        server.stop() must not leak every later node's server and engine
+        thread (that leak poisons the whole test process)."""
+        errors = []
         for n in self.nodes:
-            await n.server.stop()
-            n.instance.close()
+            try:
+                await n.server.stop()
+            except Exception as e:
+                errors.append(e)
+                log.exception("cluster stop: server %s", n.address)
+            try:
+                n.instance.close()
+            except Exception as e:
+                errors.append(e)
+                log.exception("cluster stop: instance %s", n.address)
         self.nodes = []
+        if errors:
+            raise errors[0]
 
 
 async def start_with(

@@ -163,6 +163,24 @@ def cmd_debug(args) -> int:
     for peer in snap.get("peers", []):
         print(f"peer {peer['host']}: breaker={peer['breaker']}"
               f"{' (self)' if peer['is_owner'] else ''}")
+    health = snap.get("health")
+    if health:
+        for host, st in sorted(health.get("peers", {}).items()):
+            print(f"health {host}: {st['state']} "
+                  f"fail_streak={st['fail_streak']} "
+                  f"probes={st['probes']} failures={st['failures']}")
+    gs = snap.get("global_sync")
+    if gs:
+        hints = gs.get("hints", {})
+        print(f"global_sync: send_errors={gs['send_errors']} "
+              f"broadcast_errors={gs['broadcast_errors']}")
+        print(f"hints: pending={hints.get('pending', {})} "
+              f"queued={hints.get('queued_total', {})} "
+              f"replayed={hints.get('replayed_total', {})} "
+              f"expired={hints.get('expired_total', {})}")
+    faults = snap.get("faults")
+    if faults:
+        print(f"faults ACTIVE: {faults}")
     pipe = snap.get("pipeline")
     if pipe:
         print("pipeline:", " ".join(

@@ -132,6 +132,44 @@ class QoSConfig:
 
 
 @dataclass
+class HealthConfig:
+    """Self-healing ring knobs (net/health.py + the hinted-handoff buffer
+    in core/global_sync.py + the daemon drain phase).  No reference
+    analog — the reference leans entirely on its discovery backend to
+    remove dead peers, which GUBER_STATIC_PEERS never does."""
+
+    # ---- heartbeat failure detector (net/health.py)
+    heartbeat_enabled: bool = True
+    # Probe cadence and per-probe deadline (seconds)
+    heartbeat_interval: float = 1.0
+    heartbeat_timeout: float = 0.5
+    # Consecutive probe failures before a peer is confirmed DOWN (and the
+    # ring re-homes around it); consecutive successes before a DOWN peer
+    # is confirmed UP again.  The two-sided hysteresis is what keeps a
+    # flapping peer from churning the ring on every blip.
+    suspect_after: int = 3
+    recover_after: int = 2
+    # ---- hinted handoff (core/global_sync.py)
+    # How long a failed peer's GLOBAL hits/updates are buffered before
+    # being dropped as expired (seconds), and the per-peer entry bound
+    # (oldest evicted first, counted as expired).
+    hint_ttl: float = 30.0
+    hint_max: int = 1024
+    # ---- graceful departure (daemon.py stop())
+    # Ceiling on each drain phase: in-flight window drain, global flush,
+    # and key handoff each get at most this long (seconds).
+    drain_timeout: float = 5.0
+
+    def validate(self) -> None:
+        if self.heartbeat_interval <= 0 or self.heartbeat_timeout <= 0:
+            raise ValueError("Health heartbeat interval/timeout must be > 0")
+        if self.suspect_after < 1 or self.recover_after < 1:
+            raise ValueError("Health suspect_after/recover_after must be >= 1")
+        if self.hint_ttl < 0 or self.hint_max < 0:
+            raise ValueError("Health hint_ttl/hint_max must be >= 0")
+
+
+@dataclass
 class PeerInfo:
     # reference etcd.go:29-32
     address: str = ""
@@ -148,6 +186,7 @@ class Config:
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
     qos: QoSConfig = field(default_factory=QoSConfig)
+    health: HealthConfig = field(default_factory=HealthConfig)
     # advertise address used for self-identification in the peer ring
     advertise_address: str = ""
     # Request tracing (observability/tracing.py): probability a request
@@ -217,6 +256,7 @@ class DaemonConfig:
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
     qos: QoSConfig = field(default_factory=QoSConfig)
+    health: HealthConfig = field(default_factory=HealthConfig)
 
     @property
     def k8s_enabled(self) -> bool:
@@ -408,5 +448,25 @@ def config_from_env(env_file: Optional[str] = None) -> DaemonConfig:
                                          q.breaker_half_open_probes)
     q.fail_open = env_bool("GUBER_QOS_FAIL_OPEN", q.fail_open)
     q.validate()
+
+    # Self-healing ring (net/health.py + hinted handoff + graceful drain)
+    h = c.health
+    h.heartbeat_enabled = env_bool("GUBER_HEARTBEAT_ENABLED",
+                                   h.heartbeat_enabled)
+    h.heartbeat_interval = env_float(
+        "GUBER_HEARTBEAT_INTERVAL_MS",
+        h.heartbeat_interval * 1000.0, minimum=10.0) / 1000.0
+    h.heartbeat_timeout = env_float(
+        "GUBER_HEARTBEAT_TIMEOUT_MS",
+        h.heartbeat_timeout * 1000.0, minimum=10.0) / 1000.0
+    h.suspect_after = env_int("GUBER_HEARTBEAT_SUSPECT", h.suspect_after)
+    h.recover_after = env_int("GUBER_HEARTBEAT_RECOVER", h.recover_after)
+    h.hint_ttl = env_float("GUBER_HINT_TTL_MS",
+                           h.hint_ttl * 1000.0, minimum=0.0) / 1000.0
+    h.hint_max = env_int("GUBER_HINT_MAX", h.hint_max, minimum=0)
+    h.drain_timeout = env_float("GUBER_DRAIN_TIMEOUT_MS",
+                                h.drain_timeout * 1000.0,
+                                minimum=0.0) / 1000.0
+    h.validate()
 
     return c

@@ -25,6 +25,7 @@ from gubernator_tpu.config import BehaviorConfig
 from gubernator_tpu.core.engine import RateLimitEngine
 from gubernator_tpu.core.interval import ArmedInterval
 from gubernator_tpu.core.pipeline import DispatchPipeline
+from gubernator_tpu.net.faults import FAULTS, SEAM_ENGINE_DISPATCH
 from gubernator_tpu.qos import interleave_by_tenant, shed_response
 
 
@@ -253,6 +254,8 @@ class WindowBatcher:
         before = None
 
         def run():
+            if FAULTS.enabled:
+                FAULTS.on_sync(SEAM_ENGINE_DISPATCH, "lockstep")
             nonlocal before
             before = self.engine.windows_processed
             if stacked:
@@ -407,6 +410,8 @@ class WindowBatcher:
         loop = asyncio.get_running_loop()
         start = time.monotonic()
         def run():
+            if FAULTS.enabled:
+                FAULTS.on_sync(SEAM_ENGINE_DISPATCH, "window")
             prof = self.profile
             profiling = prof is not None and prof.armed
             if profiling:

@@ -106,7 +106,11 @@ class Instance:
                                      self.metrics, lockstep_clock=clock,
                                      qos=self.qos, tracer=self.tracer)
         self.global_mgr = GlobalManager(
-            self.conf.behaviors, self, self.metrics, log)
+            self.conf.behaviors, self, self.metrics, log,
+            health=self.conf.health)
+        # failure detector handle (net/health.py), installed by whoever
+        # runs the node (daemon.py / cluster.py); introspection reads it
+        self.monitor = None
         if self.mesh_mode:
             self._picker = MeshShardPicker.for_mesh(self.engine.mesh,
                                                     mesh_peers)
@@ -395,6 +399,11 @@ class Instance:
                 status=UNHEALTHY,
                 message="lockstep dispatch failed; this host left the mesh",
                 peer_count=self.health.peer_count)
+        if self.qos is not None and self.qos.admission.draining:
+            return HealthCheckResp(
+                status=UNHEALTHY,
+                message="draining: node is departing the ring",
+                peer_count=self.health.peer_count)
         if self.qos is not None and self.qos.admission.saturated:
             return HealthCheckResp(
                 status=UNHEALTHY,
@@ -493,6 +502,55 @@ class Instance:
             np.asarray(points, np.uint32),
             np.arange(len(points), dtype=np.int32), tuple(peers), self_idx)
         pipe.rpc_enabled = True
+
+    # ------------------------------------------------------- self-healing
+
+    async def rehome(self, hosts: Sequence[str],
+                     direction: str = "down") -> None:
+        """Rebuild the ring around the given membership (the failure
+        detector's view) and migrate re-homed resident keys.  The detector
+        calls this with the current membership minus a confirmed-down peer
+        (its keyspace spreads over the survivors; its own state restarts
+        cold there — the hint buffer covers the GLOBAL hits meanwhile) or
+        plus a recovered one."""
+        old_hosts = [p.host for p in self.peer_list()]
+        new_hosts = sorted(set(hosts))
+        if sorted(old_hosts) == new_hosts:
+            return
+        await self.set_peers([
+            PeerInfo(address=h, is_owner=(h == self.advertise_address))
+            for h in new_hosts])
+        try:
+            await self.migrate_keys(old_hosts, new_hosts)
+        except Exception as e:
+            # the ring is already rewired — serving with cold keys on the
+            # new owners beats refusing to re-home
+            log.error("rehome: migration failed (keys restart cold): %s", e)
+        self.metrics.observe_rehome(direction)
+        log.warning("ring re-homed (%s): %s -> %s", direction,
+                    sorted(old_hosts), new_hosts)
+
+    def on_peer_recovered(self, host: str) -> int:
+        """Detector callback: the peer answers probes again — replay its
+        hinted GLOBAL payloads (ownership re-resolved at replay time)."""
+        return self.global_mgr.replay_hints(host)
+
+    async def drain(self, timeout: float = 5.0,
+                    now_fn=time.monotonic, sleep=asyncio.sleep) -> bool:
+        """Graceful-departure phase: close admission intake (new work is
+        shed in-band with reason `draining`) and wait — bounded by
+        `timeout` — for already-admitted decisions to finish.  Returns
+        True when the queue emptied in time."""
+        if self.qos is not None:
+            self.qos.admission.close_intake()
+        deadline = now_fn() + timeout
+        while self.qos is not None and self.qos.admission.pending > 0:
+            if now_fn() >= deadline:
+                log.warning("drain: %d decisions still pending at timeout",
+                            self.qos.admission.pending)
+                return False
+            await sleep(0.01)
+        return True
 
     # ------------------------------------------------------- state lifecycle
 
@@ -611,6 +669,17 @@ class Instance:
         if totals["moved"] or totals["gmoved"]:
             log.info("migration out: %s", totals)
         return totals
+
+    async def aclose(self) -> None:
+        """Async close: flush the GlobalManager FIRST (a clean shutdown
+        must not drop queued aggregated hits — the old stop()-only path
+        did), then tear down.  `close()` remains for sync embedders and
+        keeps the flush-less behavior only because it cannot await."""
+        try:
+            await self.global_mgr.flush()
+        except Exception as e:
+            log.error("global flush on close failed: %s", e)
+        self.close()
 
     def close(self) -> None:
         self.global_mgr.stop()

@@ -48,7 +48,11 @@ class Daemon:
         self.grpc: Optional[GrpcServer] = None
         self.http: Optional[HttpGateway] = None
         self.pool = None
+        self.monitor = None  # net/health.py HeartbeatMonitor (static pools)
         self._snapshot_task: Optional[asyncio.Task] = None
+        # phase names appended as stop() executes them, in order — the
+        # shutdown-ordering contract the signal-path tests assert
+        self.shutdown_phases: list = []
 
     def _snapshot_file(self) -> str:
         from gubernator_tpu.state.snapshot import snapshot_path
@@ -99,11 +103,18 @@ class Daemon:
             log.info("mesh mode: %d processes, %d global shards",
                      len(mesh_peers), mesh.devices.size)
 
+        # deterministic fault injection (net/faults.py): GUBER_FAULTS is
+        # read ONCE here — a production boot without it pays one attribute
+        # check per seam crossing
+        from gubernator_tpu.net.faults import FAULTS
+        FAULTS.load_from_env()
+
         self.instance = Instance(Config(
             behaviors=c.behaviors,
             engine=c.engine,
             advertise_address=c.advertise_address,
             qos=c.qos,
+            health=c.health,
         ), mesh=mesh, mesh_peers=mesh_peers)
         # compile the device step before accepting traffic; mesh mode needs a
         # cluster-agreed timestamp (all processes warm up in lockstep)
@@ -184,28 +195,125 @@ class Daemon:
             await self.pool.start()
         elif static_peers:
             from gubernator_tpu.discovery.static import StaticPool
+            addresses = [a.strip() for a in static_peers.split(",")
+                         if a.strip()]
             self.pool = StaticPool(
-                addresses=[a.strip() for a in static_peers.split(",") if a.strip()],
+                addresses=addresses,
                 advertise_address=c.advertise_address,
                 on_update=self.instance.set_peers,
             )
             await self.pool.start()
+            # Static pools have no discovery backend to remove dead peers —
+            # the heartbeat failure detector is their self-healing layer
+            # (k8s/etcd pools already watch membership; mesh membership is
+            # fixed by process rank).
+            if c.health.heartbeat_enabled:
+                from gubernator_tpu.net.health import HeartbeatMonitor
+                self.monitor = HeartbeatMonitor(
+                    self.instance, addresses, conf=c.health)
+                self.instance.monitor = self.monitor
+                self.monitor.start()
+                log.info("heartbeat detector on %d peers (interval %.1fs, "
+                         "down after %d misses)", len(addresses) - 1,
+                         c.health.heartbeat_interval, c.health.suspect_after)
 
         self.http = HttpGateway(self.instance, c.http_listen_address)
         await self.http.start()
         log.info("HTTP gateway listening on %s", c.http_listen_address)
 
     async def stop(self) -> None:
-        # shutdown order mirrors main.go:127-139: discovery, http, grpc
-        if self._snapshot_task is not None:
-            self._snapshot_task.cancel()
+        """Graceful departure, in phases (each bounded, none skippable by
+        a failure in the previous one):
+
+          1. stop the failure detector (it must not react to our own
+             departure);
+          2. drain — close admission intake (new work sheds in-band with
+             reason `draining`) and wait out already-admitted decisions;
+          3. flush the GlobalManager (queued aggregated hits/updates ship
+             now instead of being dropped by stop());
+          4. handoff — when a surviving ring remains, ship every key this
+             node owns to the survivors (skipped entirely when this node
+             is the whole ring: a handoff with no destination must not
+             hang the shutdown);
+          5. final snapshot (AFTER handoff: the snapshot then records the
+             post-departure state, so a restart doesn't resurrect keys
+             the survivors now own);
+          6. teardown: discovery, http, grpc, instance
+             (main.go:127-139 order).
+        """
+        await self._stop_monitor()
+        await self._drain_requests()
+        await self._flush_globals()
+        await self._handoff_keys()
+        await self._final_snapshot()
+        await self._teardown()
+
+    def _phase(self, name: str) -> None:
+        self.shutdown_phases.append(name)
+
+    async def _stop_monitor(self) -> None:
+        self._phase("monitor_stop")
+        if self.monitor is not None:
             try:
-                await self._snapshot_task
-            except asyncio.CancelledError:
-                pass
-            # final snapshot while the engine is still serving-quiesced:
-            # a clean shutdown loses zero decisions
-            await self._snapshot_once()
+                await self.monitor.stop()
+            except Exception:
+                log.exception("stopping heartbeat monitor failed")
+
+    async def _drain_requests(self) -> None:
+        self._phase("drain")
+        if self.instance is None:
+            return
+        try:
+            await self.instance.drain(self.conf.health.drain_timeout)
+        except Exception:
+            log.exception("drain failed; continuing shutdown")
+
+    async def _flush_globals(self) -> None:
+        self._phase("global_flush")
+        if self.instance is None:
+            return
+        try:
+            await asyncio.wait_for(self.instance.global_mgr.flush(),
+                                   self.conf.health.drain_timeout)
+        except Exception:
+            log.exception("global flush failed; continuing shutdown")
+
+    async def _handoff_keys(self) -> None:
+        inst = self.instance
+        if inst is None:
+            return
+        all_hosts = [p.host for p in inst.peer_list()]
+        survivors = [h for h in all_hosts if h != inst.advertise_address]
+        if not survivors:
+            # no surviving ring (standalone, or last node standing): the
+            # final snapshot is the only continuity there is
+            self._phase("handoff_skipped")
+            return
+        self._phase("handoff")
+        try:
+            totals = await asyncio.wait_for(
+                inst.migrate_keys(all_hosts, survivors),
+                self.conf.health.drain_timeout)
+            log.info("departure handoff: %s", totals)
+        except Exception:
+            log.exception("departure handoff failed; survivors restart "
+                          "these keys cold")
+
+    async def _final_snapshot(self) -> None:
+        if self._snapshot_task is None:
+            return
+        self._phase("snapshot")
+        self._snapshot_task.cancel()
+        try:
+            await self._snapshot_task
+        except asyncio.CancelledError:
+            pass
+        # final snapshot while the engine is serving-quiesced: a clean
+        # shutdown loses zero decisions
+        await self._snapshot_once()
+
+    async def _teardown(self) -> None:
+        self._phase("teardown")
         if self.pool is not None:
             await self.pool.close()
         if self.http is not None:
@@ -213,7 +321,7 @@ class Daemon:
         if self.grpc is not None:
             await self.grpc.stop()
         if self.instance is not None:
-            self.instance.close()
+            await self.instance.aclose()
 
 
 async def _amain(conf: DaemonConfig) -> None:

@@ -23,6 +23,7 @@ from gubernator_tpu.api.grpc_api import PeersV1Stub
 from gubernator_tpu.api.types import Behavior, RateLimitReq, RateLimitResp
 from gubernator_tpu.config import BehaviorConfig, QoSConfig
 from gubernator_tpu.core.interval import ArmedInterval
+from gubernator_tpu.net.faults import FAULTS, SEAM_PEER_RPC, FaultError
 from gubernator_tpu.observability.tracing import TRACEPARENT, current_context
 from gubernator_tpu.qos.breaker import CircuitBreaker, backoff_delays
 
@@ -75,6 +76,7 @@ class PeerClient:
         self.stub = PeersV1Stub(self.channel)
         self._raw_batch = None  # bytes-level relay, built on first use
         self._raw_transfer = None  # bytes-level bucket-migration lane
+        self._v1 = None  # V1 stub for heartbeat probes, built on first use
         self._pending: List[tuple] = []  # (req, future, trace ctx|None)
         self._interval: Optional[ArmedInterval] = None
         self._waiter: Optional[asyncio.Task] = None
@@ -105,6 +107,12 @@ class PeerClient:
                 code = code_fn()
             except Exception:
                 code = None
+        if isinstance(e, FaultError):
+            # injected partition (net/faults.py): indistinguishable from a
+            # dead peer by design
+            return PeerError(host, str(e),
+                             code=grpc.StatusCode.UNAVAILABLE,
+                             retryable=True)
         if isinstance(e, (asyncio.TimeoutError, TimeoutError)):
             return PeerError(host, "request timed out",
                              code=grpc.StatusCode.DEADLINE_EXCEEDED,
@@ -131,8 +139,11 @@ class PeerClient:
         attempt = 0
         while True:
             try:
+                if FAULTS.enabled:
+                    await FAULTS.on_async(SEAM_PEER_RPC, self.host)
                 out = await do()
-            except (grpc.RpcError, asyncio.TimeoutError, TimeoutError) as e:
+            except (grpc.RpcError, asyncio.TimeoutError, TimeoutError,
+                    FaultError) as e:
                 err = self._normalize(self.host, e)
                 if err.retryable and attempt < self.retries:
                     attempt += 1
@@ -150,6 +161,22 @@ class PeerClient:
                 raise err from e
             self.breaker.record_success()
             return out
+
+    async def health_check(self, timeout: float = 0.5):
+        """One heartbeat probe against this peer's V1 HealthCheck
+        (net/health.py's detector drives this).  Deliberately OUTSIDE the
+        resilience layer: no retries (the detector's suspicion count IS
+        the retry policy) and no breaker gate (an open breaker must never
+        stop the detector from noticing the peer came back).  The
+        peer_rpc fault seam still applies, so an injected partition
+        blacks out heartbeats exactly like real traffic."""
+        if FAULTS.enabled:
+            await FAULTS.on_async(SEAM_PEER_RPC, self.host)
+        if self._v1 is None:
+            from gubernator_tpu.api.grpc_api import V1Stub
+            self._v1 = V1Stub(self.channel)
+        return await self._v1.HealthCheck(pb.HealthCheckReq(),
+                                          timeout=timeout)
 
     # ------------------------------------------------------------ forwarding
 

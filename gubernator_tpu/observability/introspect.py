@@ -145,6 +145,20 @@ def build_debug_snapshot(instance) -> dict:
          "breaker": p.breaker.state}
         for p in instance.peer_list()
     ]
+    # what the GLOBAL plane failed to deliver + what the hint buffer holds
+    gm = getattr(instance, "global_mgr", None)
+    if gm is not None:
+        out["global_sync"] = {
+            "send_errors": dict(gm.send_errors),
+            "broadcast_errors": dict(gm.broadcast_errors),
+            "hints": gm.hints.snapshot(),
+        }
+    monitor = getattr(instance, "monitor", None)
+    if monitor is not None:
+        out["health"] = monitor.snapshot()
+    from gubernator_tpu.net.faults import FAULTS
+    if FAULTS.enabled:
+        out["faults"] = FAULTS.describe()
     pipe = instance.batcher.pipeline
     if pipe is not None:
         out["pipeline"] = {

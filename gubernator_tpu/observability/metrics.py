@@ -257,6 +257,42 @@ class Metrics:
             "owner's breaker was open.",
             registry=self.registry,
         )
+        # self-healing ring (net/health.py + global_sync hinted handoff):
+        # what we failed to send, what we buffered instead of dropping,
+        # and what the failure detector thinks of each peer
+        self.global_send_errors = Counter(
+            "global_send_errors_total",
+            "Failed per-peer GLOBAL aggregated-hit sends (after the peer "
+            "lane's own retries).",
+            ["peer"],
+            registry=self.registry,
+        )
+        self.broadcast_errors = Counter(
+            "broadcast_errors_total",
+            "Failed per-peer GLOBAL owner-broadcast sends.",
+            ["peer"],
+            registry=self.registry,
+        )
+        self.hints = Counter(
+            "guber_hints_total",
+            "Hinted-handoff buffer events, by event "
+            "(queued | replayed | expired).",
+            ["event", "peer"],
+            registry=self.registry,
+        )
+        self.peer_health_state = Gauge(
+            "guber_peer_health_state",
+            "Failure-detector verdict per peer (0=up, 1=suspect, 2=down).",
+            ["peer"],
+            registry=self.registry,
+        )
+        self.ring_rehomes = Counter(
+            "guber_ring_rehomes_total",
+            "Automatic ring membership changes driven by the failure "
+            "detector, by direction (down | up).",
+            ["direction"],
+            registry=self.registry,
+        )
         # stage-latency decomposition (observability/tracing.py records the
         # same boundaries as spans): per-stage wall time at window/drain
         # granularity, always on — a few µs per window, amortized over up
@@ -326,6 +362,33 @@ class Metrics:
 
     def observe_peer_retry(self, peer: str) -> None:
         self.peer_retries.labels(peer=peer).inc()
+
+    def observe_global_error(self, peer: str, kind: str,
+                             queued: int = 0) -> None:
+        """One failed per-peer GLOBAL send (kind: hits|update), plus how
+        many NEW hint entries it buffered."""
+        if kind == "update":
+            self.broadcast_errors.labels(peer=peer).inc()
+        else:
+            self.global_send_errors.labels(peer=peer).inc()
+        if queued > 0:
+            self.hints.labels(event="queued", peer=peer).inc(queued)
+
+    def observe_hints(self, peer: str, replayed: int = 0,
+                      expired: int = 0) -> None:
+        if replayed:
+            self.hints.labels(event="replayed", peer=peer).inc(replayed)
+        if expired:
+            self.hints.labels(event="expired", peer=peer).inc(expired)
+
+    _HEALTH_STATES = {"up": 0, "suspect": 1, "down": 2}
+
+    def observe_peer_health(self, peer: str, state: str) -> None:
+        self.peer_health_state.labels(peer=peer).set(
+            self._HEALTH_STATES.get(state, 0))
+
+    def observe_rehome(self, direction: str) -> None:
+        self.ring_rehomes.labels(direction=direction).inc()
 
     def observe_snapshot(self, seconds: float, size_bytes: int,
                          ok: bool) -> None:

@@ -34,7 +34,7 @@ sleeping.
 from __future__ import annotations
 
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 from gubernator_tpu.config import QoSConfig
 from gubernator_tpu.qos.admission import AdmissionController, shed_response
@@ -68,6 +68,11 @@ class QoSManager:
         self.admission = AdmissionController(self.conf, self.congestion,
                                              metrics=metrics, now_fn=now_fn)
         self.fair_slotting = self.conf.fair_slotting
+        # per-host registry of the breakers minted below, so the failure
+        # detector (net/health.py) can force-trip a confirmed-down peer's
+        # breaker and force-close a recovered one (latest mint wins after
+        # membership churn — the ring's live PeerClient holds that one)
+        self.breakers: Dict[str, CircuitBreaker] = {}
 
     @property
     def fail_open(self) -> bool:
@@ -79,13 +84,15 @@ class QoSManager:
         if self.metrics is not None:
             m = self.metrics
             on_change = lambda state, h=host: m.observe_breaker(h, state)  # noqa: E731
-        return CircuitBreaker(
+        breaker = CircuitBreaker(
             fail_threshold=self.conf.breaker_fail_threshold,
             open_duration=self.conf.breaker_open_duration,
             half_open_probes=self.conf.breaker_half_open_probes,
             now_fn=self.now_fn,
             on_state_change=on_change,
         )
+        self.breakers[host] = breaker
+        return breaker
 
     def deadline_from_timeout(self, timeout_s: Optional[float]
                               ) -> Optional[float]:

@@ -32,6 +32,7 @@ from gubernator_tpu.api.types import RateLimitReq, RateLimitResp, Status
 SHED_QUEUE_FULL = "queue_full"
 SHED_DEADLINE = "deadline"
 SHED_BREAKER_OPEN = "breaker_open"
+SHED_DRAINING = "draining"
 
 
 def shed_response(req: RateLimitReq, reason: str) -> RateLimitResp:
@@ -56,6 +57,10 @@ class AdmissionController:
         self.pending = 0
         self.pending_peak = 0
         self.shed_counts: dict = {}
+        # Set during graceful departure (daemon.py stop()): new work is
+        # shed in-band with reason `draining` while already-admitted
+        # decisions keep their slots and drain normally.
+        self.draining = False
 
     # ----------------------------------------------------------- accounting
 
@@ -64,6 +69,8 @@ class AdmissionController:
         """Admit `n` decisions or return the shed reason.  On admission the
         caller OWNS the slots and must `release(n)` when the decisions
         resolve (success or failure)."""
+        if self.draining:
+            return self._shed(SHED_DRAINING, n)
         if self.max_pending > 0 and self.pending + n > self.max_pending:
             return self._shed(SHED_QUEUE_FULL, n)
         if deadline is not None:
@@ -95,6 +102,13 @@ class AdmissionController:
         report degraded, and the server bypasses the native RPC lane so
         per-item sheds carry their reason in-band."""
         return self.max_pending > 0 and self.pending >= self.max_pending
+
+    def close_intake(self) -> None:
+        """Graceful-departure phase 1: stop admitting, keep draining."""
+        self.draining = True
+
+    def open_intake(self) -> None:
+        self.draining = False
 
     def record_shed(self, reason: str, n: int = 1) -> str:
         """Account a shed decided OUTSIDE try_admit (e.g. fail-closed

@@ -90,6 +90,23 @@ class CircuitBreaker:
         self._failures = 0
         self._set_state(OPEN)
 
+    # ------------------------------------------------- external authority
+
+    def trip(self) -> None:
+        """Force-open: the failure detector (net/health.py) confirmed this
+        peer DOWN out-of-band, so stop burning forward-latency on probes
+        the detector already knows will fail.  The normal open→half_open
+        clockwork still applies, so the breaker recovers on its own even
+        if the detector is later disabled."""
+        self._trip()
+
+    def reset(self) -> None:
+        """Force-closed: the detector confirmed the peer healthy again
+        (its recover_after hysteresis already debounced flapping)."""
+        self._failures = 0
+        self._probes_in_flight = 0
+        self._set_state(CLOSED)
+
 
 def backoff_delays(retries: int, base: float, cap: float,
                    rng: Optional[random.Random] = None) -> Iterator[float]:

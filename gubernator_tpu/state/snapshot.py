@@ -356,6 +356,9 @@ def loads(data: bytes) -> ArenaSnapshot:
 def save(snap: ArenaSnapshot, path: str) -> int:
     """Atomic write (tmp + rename): a crash mid-write leaves the previous
     snapshot intact.  Returns the byte size written."""
+    from gubernator_tpu.net.faults import FAULTS, SEAM_SNAPSHOT_IO
+    if FAULTS.enabled:
+        FAULTS.on_sync(SEAM_SNAPSHOT_IO, path)
     data = dumps(snap)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
@@ -367,6 +370,9 @@ def save(snap: ArenaSnapshot, path: str) -> int:
 
 
 def load(path: str) -> ArenaSnapshot:
+    from gubernator_tpu.net.faults import FAULTS, SEAM_SNAPSHOT_IO
+    if FAULTS.enabled:
+        FAULTS.on_sync(SEAM_SNAPSHOT_IO, path)
     with open(path, "rb") as f:
         return loads(f.read())
 
@@ -390,7 +396,10 @@ def restore_engine(engine, path: str, rebase_to: Optional[int] = None,
     except FileNotFoundError:
         log.info("no snapshot at %s; starting cold", path)
         return None
-    except SnapshotError as e:
+    except (SnapshotError, OSError) as e:
+        # OSError covers real disk failures AND the injected snapshot_io
+        # faults (net/faults.py FaultError is an OSError by design) — both
+        # must degrade to a cold start, never a failed boot
         log.warning("snapshot %s unusable (%s); starting cold", path, e)
         return None
     try:

@@ -1,0 +1,162 @@
+"""Open-loop partition probe for the self-healing subsystem.
+
+Boots an in-process loopback cluster, runs open-loop traffic against one
+node, and — mid-run — injects an asymmetric partition toward one peer via
+the deterministic fault layer (net/faults.py), then heals it.  Reports
+per-phase:
+
+    goodput (served/s) | degraded (fail-open/shed) | transport errors
+
+plus, for the GLOBAL plane, how many hits were hinted during the
+partition and how many replayed after the heal (the delta is the loss,
+bounded by GUBER_HINT_TTL_MS).  The pass criterion mirrors the chaos
+suite: transport errors stay ZERO in every phase — a partitioned peer
+costs degraded answers, never failed RPCs.
+
+    JAX_PLATFORMS=cpu python scripts/probe_partition.py
+    JAX_PLATFORMS=cpu python scripts/probe_partition.py \
+        --nodes 3 --seconds 2 --rps 300
+"""
+import argparse
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_req(i, global_every):
+    from gubernator_tpu.api.types import Behavior, RateLimitReq, Second
+    behavior = (Behavior.GLOBAL if global_every and i % global_every == 0
+                else Behavior.BATCHING)
+    return RateLimitReq(name=f"tenant-{i % 4}", unique_key=f"probe-{i % 256}",
+                        hits=1, limit=1 << 30, duration=60 * Second,
+                        behavior=behavior)
+
+
+async def open_loop(inst, rps, seconds, global_every):
+    """Fixed arrival schedule; never waits for completions."""
+    interval = 1.0 / rps
+    # transport = the RPC itself failed (the one thing self-healing must
+    # never let the client see); item_errors = valid responses carrying an
+    # in-band per-item error (the documented degraded mode during the
+    # suspicion window, before the breaker/detector react)
+    stats = {"served": 0, "degraded": 0, "item_errors": 0, "transport": 0}
+    tasks = []
+    start = time.monotonic()
+    i = 0
+
+    async def one(idx):
+        try:
+            r = (await inst.get_rate_limits([make_req(idx, global_every)]))[0]
+        except Exception:
+            stats["transport"] += 1
+            return
+        meta = r.metadata or {}
+        if meta.get("shed_reason") or meta.get("degraded"):
+            stats["degraded"] += 1
+        elif r.error:
+            stats["item_errors"] += 1
+        else:
+            stats["served"] += 1
+
+    while time.monotonic() - start < seconds:
+        due = start + i * interval
+        delay = due - time.monotonic()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        tasks.append(asyncio.ensure_future(one(i)))
+        i += 1
+    await asyncio.gather(*tasks)
+    wall = time.monotonic() - start
+    stats["goodput"] = stats["served"] / wall
+    return stats
+
+
+def hint_totals(inst):
+    snap = inst.global_mgr.hints.snapshot()
+    return (sum(snap["queued_total"].values()),
+            sum(snap["replayed_total"].values()),
+            sum(snap["expired_total"].values()))
+
+
+async def amain(args):
+    from gubernator_tpu import cluster as cluster_mod
+    from gubernator_tpu.net.faults import FAULTS, SEAM_PEER_RPC
+
+    print(f"booting {args.nodes}-node loopback cluster...", flush=True)
+    c = await cluster_mod.start(args.nodes)
+    try:
+        inst = c.instance_at(0)
+        victim = c.peer_at(args.nodes - 1)  # partition the last node away
+        print(f"driving node 0 ({c.peer_at(0)}); "
+              f"partition target {victim}\n", flush=True)
+        print(f"{'phase':<12} {'goodput':>10} {'degraded':>9} "
+              f"{'item err':>9} {'transport':>10}")
+
+        async def phase(name):
+            r = await open_loop(inst, args.rps, args.seconds,
+                                args.global_every)
+            print(f"{name:<12} {r['goodput']:>8,.0f}/s {r['degraded']:>9} "
+                  f"{r['item_errors']:>9} {r['transport']:>10}", flush=True)
+            return r
+
+        results = {"baseline": await phase("baseline")}
+
+        FAULTS.seed(args.seed)
+        FAULTS.configure(SEAM_PEER_RPC, drop=1.0, match=victim)
+        q0, r0, e0 = hint_totals(inst)
+        results["partition"] = await phase("partition")
+        q1, _, _ = hint_totals(inst)
+
+        FAULTS.clear()
+        # emulate the failure detector's recovery verdict (no monitor runs
+        # in this harness): force-close the victim's breaker on every node
+        # and replay its hinted payloads (net/health.py _on_peer_up)
+        replayed = 0
+        for n in c.nodes:
+            if n.instance.qos is not None:
+                breaker = n.instance.qos.breakers.get(victim)
+                if breaker is not None:
+                    breaker.reset()
+            replayed += n.instance.global_mgr.replay_hints(victim)
+        results["healed"] = await phase("healed")
+        q2, r2, e2 = hint_totals(inst)
+
+        print(f"\nhints: {q1 - q0} queued during the partition, "
+              f"{replayed + (r2 - r0)} replayed after the heal, "
+              f"{e2 - e0} expired (loss, bounded by the hint TTL)")
+        errors = sum(r["transport"] for r in results.values())
+        print("PASS: zero transport errors in every phase" if errors == 0
+              else f"FAIL: {errors} transport errors leaked to the client")
+        return 0 if errors == 0 else 1
+    finally:
+        FAULTS.clear()
+        await c.stop()
+
+
+def main():
+    import logging
+    p = argparse.ArgumentParser("probe_partition")
+    p.add_argument("--nodes", type=int, default=3)
+    p.add_argument("--seconds", type=float, default=2.0,
+                   help="duration of each phase")
+    p.add_argument("--rps", type=float, default=200.0,
+                   help="open-loop arrival rate")
+    p.add_argument("--global-every", type=int, default=8,
+                   help="every Nth request uses Behavior.GLOBAL "
+                   "(0 disables)")
+    p.add_argument("--seed", type=int, default=7,
+                   help="fault-injection RNG seed (replayable schedule)")
+    p.add_argument("--verbose", action="store_true",
+                   help="keep the per-send error logs (noisy during the "
+                   "partition phase by design)")
+    args = p.parse_args()
+    if not args.verbose:
+        logging.getLogger("gubernator").setLevel(logging.CRITICAL)
+    sys.exit(asyncio.run(amain(args)))
+
+
+if __name__ == "__main__":
+    main()

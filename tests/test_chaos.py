@@ -1,6 +1,26 @@
-"""Chaos: peer death mid-flight (SURVEY §4 gap — the reference has no such
-test).  A 3-node cluster keeps serving its own keys with per-item error
-semantics while one peer is down, and heals when membership catches up."""
+"""Chaos suite: the self-healing ring under deliberate failure.
+
+Exercises PR 7's failure-handling subsystem end to end on the in-process
+cluster harness plus fake-clock unit drills:
+
+  * deterministic fault injection (net/faults.py): seeded decisions, the
+    spec grammar, the one-attribute-check disabled path (asserted the
+    same way as the tracing-off path);
+  * heartbeat failure detection (net/health.py): suspicion counts,
+    two-sided flap hysteresis, breaker force-trip, automatic ring
+    re-home on confirmed death AND recovery;
+  * hinted handoff (core/global_sync.py): failed GLOBAL sends buffer
+    instead of dropping, replay on recovery re-resolves ownership, loss
+    is bounded by the hint TTL;
+  * kill-owner-mid-traffic on a real loopback cluster: the keyspace
+    re-homes within the suspicion window and clients NEVER see transport
+    errors (degraded responses allowed);
+  * snapshot IO failure: injected disk faults degrade to failed-snapshot
+    metrics and cold starts, never crashes.
+
+Everything except the legacy slow soak runs on injectable clocks /
+drivable probe rounds, so the suite is tier-1 deterministic.
+"""
 
 import asyncio
 
@@ -10,7 +30,35 @@ import pytest
 import gubernator_tpu  # noqa: F401
 from gubernator_tpu import cluster as cluster_mod
 from gubernator_tpu.api import pb
-from gubernator_tpu.config import PeerInfo
+from gubernator_tpu.api.types import Behavior, RateLimitReq, Status
+from gubernator_tpu.config import (
+    BehaviorConfig,
+    Config,
+    EngineConfig,
+    HealthConfig,
+    PeerInfo,
+    QoSConfig,
+)
+from gubernator_tpu.core.global_sync import (
+    HINT_HITS,
+    HINT_UPDATE,
+    GlobalManager,
+    HintBuffer,
+)
+from gubernator_tpu.core.service import Instance
+from gubernator_tpu.net.faults import (
+    FAULTS,
+    SEAM_ENGINE_DISPATCH,
+    SEAM_PEER_RPC,
+    SEAM_SNAPSHOT_IO,
+    FaultError,
+    FaultInjector,
+)
+from gubernator_tpu.net.health import DOWN, SUSPECT, UP, HeartbeatMonitor
+from gubernator_tpu.qos.admission import SHED_DRAINING
+from gubernator_tpu.qos.breaker import CLOSED, OPEN, CircuitBreaker
+
+pytestmark = pytest.mark.chaos
 
 
 @pytest.fixture(scope="module")
@@ -20,8 +68,28 @@ def loop():
     loop.close()
 
 
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test starts and ends with the injector disabled — a leaked
+    rule would silently poison every later test in the process."""
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
 def run(loop, coro):
     return loop.run_until_complete(asyncio.wait_for(coro, timeout=120))
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
 
 
 def _payload(n, name="chaos"):
@@ -30,6 +98,639 @@ def _payload(n, name="chaos"):
                         limit=1_000, duration=60_000)
         for i in range(n)
     ]).SerializeToString()
+
+
+def _req(key, hits=1, behavior=Behavior.BATCHING, limit=1000):
+    return RateLimitReq(name="chaos", unique_key=key, hits=hits,
+                        limit=limit, duration=60_000, behavior=behavior)
+
+
+# ------------------------------------------------------------ fault injector
+
+
+def test_faults_disabled_by_default_one_attribute_check(monkeypatch):
+    """The disabled hot path is ONE attribute check (the tracing-off
+    discipline): with no rules installed, a seam crossing must never
+    reach the injector's decision machinery."""
+    assert FAULTS.enabled is False
+
+    def boom(*a, **k):
+        raise AssertionError("disabled path consulted the injector")
+
+    monkeypatch.setattr(FAULTS, "_decide", boom)
+    # a real seam call site: snapshot load guards on FAULTS.enabled
+    from gubernator_tpu.state import snapshot as snapmod
+    with pytest.raises(FileNotFoundError):  # NOT AssertionError
+        snapmod.load("/nonexistent/guber-chaos.snap")
+
+
+def test_faults_seeded_determinism():
+    """Same seed + same call sequence => identical drop schedule."""
+    def schedule(seed):
+        f = FaultInjector(seed=seed)
+        f.configure(SEAM_PEER_RPC, drop=0.5)
+        out = []
+        for _ in range(64):
+            try:
+                f.on_sync(SEAM_PEER_RPC, "peer:1")
+                out.append(0)
+            except FaultError:
+                out.append(1)
+        return out
+
+    a, b, c = schedule(7), schedule(7), schedule(8)
+    assert a == b
+    assert a != c  # different seed gives a different schedule
+    assert 0 < sum(a) < 64  # drop=0.5 actually mixes outcomes
+
+
+def test_faults_spec_grammar():
+    f = FaultInjector()
+    f.load_spec("peer_rpc:drop=0.1,delay_ms=50,match=host-b;"
+                "snapshot_io:error;engine_dispatch:drop=1.0,times=2")
+    d = f.describe()
+    assert d[SEAM_PEER_RPC][0]["match"] == "host-b"
+    assert d[SEAM_PEER_RPC][0]["delay_ms"] == 50.0
+    assert d[SEAM_SNAPSHOT_IO][0]["drop"] == 1.0  # error == drop=1.0
+    assert d[SEAM_ENGINE_DISPATCH][0]["remaining"] == 2
+    with pytest.raises(ValueError):
+        FaultInjector().load_spec("peer_rpc:banana=1")
+
+
+def test_faults_match_is_an_asymmetric_partition():
+    """match= scopes a rule to one target: traffic to host-b blackholes
+    while host-a stays reachable — an asymmetric partition in one rule."""
+    f = FaultInjector(seed=1)
+    f.configure(SEAM_PEER_RPC, drop=1.0, match="host-b:81")
+    f.on_sync(SEAM_PEER_RPC, "host-a:81")  # passes
+    with pytest.raises(FaultError):
+        f.on_sync(SEAM_PEER_RPC, "host-b:81")
+    f.on_sync(SEAM_PEER_RPC, "host-a:81")  # still passes
+
+
+def test_faults_times_budget_exhausts():
+    f = FaultInjector(seed=1)
+    f.configure(SEAM_SNAPSHOT_IO, drop=1.0, times=2)
+    for _ in range(2):
+        with pytest.raises(FaultError):
+            f.on_sync(SEAM_SNAPSHOT_IO, "p")
+    f.on_sync(SEAM_SNAPSHOT_IO, "p")  # budget spent: passes forever after
+    f.on_sync(SEAM_SNAPSHOT_IO, "p")
+
+
+def test_fault_error_is_an_oserror():
+    # snapshot-IO handlers catch OSError; the peer lane normalizes it —
+    # both rely on this subclassing
+    assert issubclass(FaultError, OSError)
+
+
+# ------------------------------------------------------------- hint buffer
+
+
+def test_hint_buffer_aggregates_and_replays():
+    clk = FakeClock()
+    hb = HintBuffer(ttl=30.0, max_per_peer=8, now_fn=clk)
+    hb.put("p:1", HINT_HITS, _req("a", hits=2))
+    hb.put("p:1", HINT_HITS, _req("a", hits=3))  # same key: aggregate
+    hb.put("p:1", HINT_UPDATE, _req("a", hits=1))  # update kind: distinct
+    assert hb.pending("p:1") == 2
+    entries = dict()
+    for kind, req in hb.take("p:1"):
+        entries[kind] = req
+    assert entries[HINT_HITS].hits == 5  # 2+3 aggregated, one entry
+    assert hb.pending("p:1") == 0  # take drains
+
+
+def test_hint_buffer_ttl_bounds_loss():
+    clk = FakeClock()
+    hb = HintBuffer(ttl=10.0, max_per_peer=8, now_fn=clk)
+    hb.put("p:1", HINT_HITS, _req("a"))
+    clk.advance(5.0)
+    hb.put("p:1", HINT_HITS, _req("b"))
+    clk.advance(6.0)  # 'a' is 11s old (> ttl), 'b' is 6s old
+    taken = hb.take("p:1")
+    assert [r.unique_key for _, r in taken] == ["b"]
+    assert hb.expired.get("p:1") == 1
+    # aggregation refreshes the TTL: a re-hinted key survives the window
+    hb.put("p:1", HINT_HITS, _req("c"))
+    clk.advance(6.0)
+    hb.put("p:1", HINT_HITS, _req("c"))
+    clk.advance(6.0)
+    assert [r.unique_key for _, r in hb.take("p:1")] == ["c"]
+
+
+def test_hint_buffer_bound_evicts_oldest():
+    clk = FakeClock()
+    hb = HintBuffer(ttl=60.0, max_per_peer=3, now_fn=clk)
+    for i in range(5):
+        hb.put("p:1", HINT_HITS, _req(f"k{i}"))
+    taken = [r.unique_key for _, r in hb.take("p:1")]
+    assert taken == ["k2", "k3", "k4"]  # oldest two evicted
+    assert hb.expired.get("p:1") == 2
+    assert hb.queued.get("p:1") == 5
+
+
+# ------------------------------------------------- breaker / admission drain
+
+
+def test_breaker_force_trip_and_reset():
+    clk = FakeClock()
+    b = CircuitBreaker(fail_threshold=5, open_duration=2.0, now_fn=clk)
+    assert b.state == CLOSED
+    b.trip()  # detector verdict: no need for 5 organic failures
+    assert b.state == OPEN and not b.allow()
+    b.reset()
+    assert b.state == CLOSED and b.allow()
+    # force-opened breakers still self-heal through the normal clockwork
+    b.trip()
+    clk.advance(2.5)
+    assert b.allow()  # half-open probe
+    b.record_success()
+    assert b.state == CLOSED
+
+
+def test_admission_drain_sheds_inband():
+    from gubernator_tpu.qos import QoSManager
+    q = QoSManager(QoSConfig(max_pending=8))
+    assert q.admission.try_admit(1) is None
+    q.admission.close_intake()
+    assert q.admission.try_admit(1) == SHED_DRAINING
+    # already-admitted work still releases normally
+    q.admission.release(1)
+    assert q.admission.pending == 0
+    q.admission.open_intake()
+    assert q.admission.try_admit(1) is None
+
+
+# ------------------------------------------------------- failure detector
+
+
+class StubRing:
+    """Instance stand-in recording the detector's verdict actions."""
+
+    def __init__(self, host="self:1"):
+        self.advertise_address = host
+        self.qos = None
+        self.metrics = None
+        self.rehomes = []
+        self.recovered = []
+        self.conf = Config()
+
+    async def rehome(self, hosts, direction="down"):
+        self.rehomes.append((tuple(hosts), direction))
+
+    def on_peer_recovered(self, host):
+        self.recovered.append(host)
+
+
+def _monitor(inst, peers, ok, suspect_after=3, recover_after=2):
+    """Detector with an injected probe: `ok[host]` decides each probe."""
+    async def probe(host):
+        if not ok[host]:
+            raise ConnectionError("probe refused")
+
+    conf = HealthConfig(suspect_after=suspect_after,
+                        recover_after=recover_after)
+    clk = FakeClock()
+    return HeartbeatMonitor(inst, peers, conf=conf, probe_fn=probe,
+                            now_fn=clk), clk
+
+
+def test_detector_confirms_down_and_rehomes(loop):
+    async def body():
+        inst = StubRing()
+        ok = {"peer:2": True, "peer:3": True}
+        mon, _ = _monitor(inst, ["self:1", "peer:2", "peer:3"], ok,
+                          suspect_after=3)
+        await mon.probe_once()
+        assert mon.snapshot()["peers"]["peer:2"]["state"] == UP
+
+        ok["peer:2"] = False
+        await mon.probe_once()  # miss 1: suspect, no verdict yet
+        assert mon.snapshot()["peers"]["peer:2"]["state"] == SUSPECT
+        assert inst.rehomes == []
+        await mon.probe_once()  # miss 2
+        await mon.probe_once()  # miss 3: confirmed DOWN
+        assert mon.snapshot()["peers"]["peer:2"]["state"] == DOWN
+        # ring re-homed around the dead peer, exactly once
+        assert inst.rehomes == [(("peer:3", "self:1"), "down")]
+
+        ok["peer:2"] = True
+        await mon.probe_once()  # recovery 1 of 2: still down
+        assert mon.snapshot()["peers"]["peer:2"]["state"] == DOWN
+        await mon.probe_once()  # recovery 2: confirmed UP again
+        assert mon.snapshot()["peers"]["peer:2"]["state"] == UP
+        assert inst.rehomes[-1] == (("peer:2", "peer:3", "self:1"), "up")
+        assert inst.recovered == ["peer:2"]  # hint replay triggered
+
+    run(loop, body())
+
+
+def test_detector_flap_hysteresis_never_churns_ring(loop):
+    """A peer failing every other probe never accumulates suspect_after
+    CONSECUTIVE misses — the ring must not re-home once."""
+    async def body():
+        inst = StubRing()
+        ok = {"peer:2": True}
+        mon, _ = _monitor(inst, ["self:1", "peer:2"], ok, suspect_after=3)
+        for i in range(12):
+            ok["peer:2"] = (i % 2 == 0)
+            await mon.probe_once()
+        assert inst.rehomes == []
+        assert mon.snapshot()["peers"]["peer:2"]["failures"] == 6
+
+    run(loop, body())
+
+
+def test_detector_force_trips_breaker(loop):
+    async def body():
+        inst = StubRing()
+        from gubernator_tpu.qos import QoSManager
+        inst.qos = QoSManager(QoSConfig())
+        breaker = inst.qos.make_breaker("peer:2")
+        ok = {"peer:2": False}
+        mon, _ = _monitor(inst, ["self:1", "peer:2"], ok, suspect_after=2)
+        await mon.probe_once()
+        assert breaker.state == CLOSED  # suspicion alone trips nothing
+        await mon.probe_once()
+        assert breaker.state == OPEN  # confirmed down: forced open
+        ok["peer:2"] = True
+        await mon.probe_once()
+        await mon.probe_once()
+        assert breaker.state == CLOSED  # confirmed up: forced closed
+
+    run(loop, body())
+
+
+# --------------------------------------------------- global hinted handoff
+
+
+class StubPeer:
+    def __init__(self, host, fail=False):
+        self.host = host
+        self.is_owner = False
+        self.fail = fail
+        self.received = []
+        self.updates = []
+
+    async def get_peer_rate_limits(self, reqs):
+        if self.fail:
+            raise ConnectionError(f"{self.host} unreachable")
+        self.received.extend(reqs)
+        return [None] * len(reqs)
+
+    async def update_peer_globals(self, globals_):
+        if self.fail:
+            raise ConnectionError(f"{self.host} unreachable")
+        self.updates.append(list(globals_))
+
+
+class StubOwnerInstance:
+    """Instance stand-in for GlobalManager: one remote owner peer."""
+
+    def __init__(self, peer):
+        self.peer = peer
+
+    def get_peer(self, key):
+        return self.peer
+
+    def peer_list(self):
+        return [self.peer]
+
+    async def read_global_status(self, probe):
+        from gubernator_tpu.api.types import RateLimitResp
+        return RateLimitResp(status=Status.UNDER_LIMIT, limit=probe.limit,
+                             remaining=probe.limit)
+
+
+def _gm(peer, clk):
+    inst = StubOwnerInstance(peer)
+    gm = GlobalManager(BehaviorConfig(global_sync_wait=0.01), inst,
+                       metrics=None, log=None,
+                       health=HealthConfig(hint_ttl=30.0, hint_max=64),
+                       now_fn=clk)
+    gm.start()
+    return gm
+
+
+def test_send_failure_buffers_hints_then_replays(loop):
+    async def body():
+        clk = FakeClock()
+        peer = StubPeer("owner:1", fail=True)
+        gm = _gm(peer, clk)
+        gm.queue_hit(_req("a", hits=2, behavior=Behavior.GLOBAL))
+        gm.queue_hit(_req("a", hits=3, behavior=Behavior.GLOBAL))
+        await gm._send_hits()
+        # dropped on the floor before PR 7; now: counted AND buffered
+        assert gm.send_errors == {"owner:1": 1}
+        assert gm.hints.pending("owner:1") == 1  # aggregated to one entry
+
+        peer.fail = False
+        assert gm.replay_hints("owner:1") == 1
+        await gm._send_hits()  # replay re-queued through queue_hit
+        assert len(peer.received) == 1
+        assert peer.received[0].hits == 5  # 2+3 survived the outage intact
+        assert gm.hints.pending("owner:1") == 0
+        gm.stop()
+
+    run(loop, body())
+
+
+def test_hint_loss_is_bounded_by_ttl(loop):
+    async def body():
+        clk = FakeClock()
+        peer = StubPeer("owner:1", fail=True)
+        gm = _gm(peer, clk)
+        gm.queue_hit(_req("early", behavior=Behavior.GLOBAL))
+        await gm._send_hits()
+        clk.advance(31.0)  # past hint_ttl=30
+        gm.queue_hit(_req("late", behavior=Behavior.GLOBAL))
+        await gm._send_hits()
+
+        peer.fail = False
+        assert gm.replay_hints("owner:1") == 1  # only 'late' survived
+        await gm._send_hits()
+        assert [r.unique_key for r in peer.received] == ["late"]
+        assert gm.hints.expired.get("owner:1") == 1  # the bounded loss
+        gm.stop()
+
+    run(loop, body())
+
+
+def test_broadcast_failure_buffers_and_replays_fresh_status(loop):
+    async def body():
+        clk = FakeClock()
+        peer = StubPeer("replica:1", fail=True)
+        gm = _gm(peer, clk)
+        gm.queue_update(_req("gk", hits=1, behavior=Behavior.GLOBAL))
+        await gm._broadcast()
+        assert gm.broadcast_errors == {"replica:1": 1}
+        assert gm.hints.pending("replica:1") == 1
+
+        peer.fail = False
+        gm.replay_hints("replica:1")
+        await gm._broadcast()
+        # the replica got a FRESH authoritative status, not a stale one
+        assert len(peer.updates) == 1
+        assert peer.updates[0][0].status.remaining == 1000
+        gm.stop()
+
+    run(loop, body())
+
+
+def test_global_flush_ships_queued_hits_on_shutdown(loop):
+    """Satellite bugfix: stop() used to cancel senders and silently drop
+    queued hits — flush() must deliver them first."""
+    async def body():
+        clk = FakeClock()
+        peer = StubPeer("owner:1")
+        gm = _gm(peer, clk)
+        gm.queue_hit(_req("pending-at-shutdown", hits=7,
+                          behavior=Behavior.GLOBAL))
+        await gm.flush()
+        gm.stop()
+        assert [r.unique_key for r in peer.received] == \
+            ["pending-at-shutdown"]
+        assert peer.received[0].hits == 7
+
+    run(loop, body())
+
+
+# --------------------------------------------------------- engine dispatch
+
+
+def _instance(qos_conf=None):
+    # use_native=False: the classic window path is where the
+    # engine_dispatch fault seam lives (core/batcher.py _run_window)
+    inst = Instance(Config(
+        behaviors=BehaviorConfig(),
+        engine=EngineConfig(capacity_per_shard=2048, batch_per_shard=128,
+                            global_capacity=64, global_batch_per_shard=16,
+                            max_global_updates=16, use_native=False),
+        qos=qos_conf or QoSConfig()))
+    inst.engine.warmup()
+    return inst
+
+
+def test_engine_dispatch_fault_is_survivable(loop):
+    """An injected device-dispatch failure fails that window's waiters
+    but the serving loop keeps going — the next window serves."""
+    async def body():
+        inst = _instance()
+        try:
+            FAULTS.seed(1)
+            FAULTS.configure(SEAM_ENGINE_DISPATCH, drop=1.0, times=1)
+            with pytest.raises(Exception):
+                await inst.get_rate_limits([_req("w1")])
+            FAULTS.clear()
+            resp = (await inst.get_rate_limits([_req("w2")]))[0]
+            assert resp.error == ""
+            assert resp.remaining == 999
+        finally:
+            FAULTS.clear()
+            inst.close()
+
+    run(loop, body())
+
+
+def test_instance_drain_with_fake_clock(loop):
+    async def body():
+        inst = _instance(QoSConfig(max_pending=8))
+        try:
+            clk = FakeClock()
+
+            async def fake_sleep(dt):
+                clk.advance(1.0)
+
+            # pending work that never resolves: drain must give up at the
+            # timeout on the fake clock, not hang
+            inst.qos.admission.pending = 3
+            drained = await inst.drain(timeout=5.0, now_fn=clk,
+                                       sleep=fake_sleep)
+            assert drained is False
+            assert inst.qos.admission.draining  # intake stays closed
+            shed = (await inst.get_rate_limits([_req("late")]))[0]
+            assert shed.metadata["shed_reason"] == SHED_DRAINING
+            inst.qos.admission.pending = 0
+            drained = await inst.drain(timeout=5.0, now_fn=clk,
+                                       sleep=fake_sleep)
+            assert drained is True
+        finally:
+            inst.close()
+
+    run(loop, body())
+
+
+# ---------------------------------------------------------- snapshot faults
+
+
+def test_snapshot_io_fault_degrades_not_crashes(tmp_path, loop):
+    async def body():
+        inst = _instance()
+        path = str(tmp_path / "arena.snap")
+        try:
+            # healthy save first, so a real file exists
+            await inst.save_snapshot(path)
+
+            FAULTS.seed(2)
+            FAULTS.configure(SEAM_SNAPSHOT_IO, drop=1.0)
+            with pytest.raises(OSError):
+                await inst.save_snapshot(path)
+            # the previous snapshot file is intact (fault fired before
+            # the tmp+rename, and rename is atomic anyway)
+            from gubernator_tpu.state.snapshot import load, restore_engine
+            # restore under an injected IO fault: cold start, not a crash
+            assert restore_engine(inst.engine, path) is None
+            FAULTS.clear()
+            assert load(path).total_keys() >= 0  # file still parses
+
+            # daemon periodic-snapshot wrapper: failure lands in metrics
+            from gubernator_tpu.daemon import Daemon
+            from gubernator_tpu.config import DaemonConfig
+            d = Daemon(DaemonConfig(snapshot_dir=str(tmp_path)))
+            d.instance = inst
+            FAULTS.configure(SEAM_SNAPSHOT_IO, drop=1.0)
+            await d._snapshot_once()  # must not raise
+            failed = inst.metrics.snapshot_total.labels(
+                status="failed")._value.get()
+            assert failed >= 1
+        finally:
+            FAULTS.clear()
+            inst.close()
+
+    run(loop, body())
+
+
+# ------------------------------------------------- kill the owner, re-home
+
+
+def test_kill_owner_rehomes_within_suspicion_window(loop):
+    """The acceptance scenario: a 3-node loopback cluster under traffic
+    loses the owner of live keys.  The detectors on the survivors confirm
+    it down within the suspicion window, re-home its keyspace, and every
+    subsequent request is answered with NO transport errors."""
+    async def body():
+        c = await cluster_mod.start(3)
+        monitors = []
+        try:
+            keys = [f"k{i}" for i in range(40)]
+            inst0 = c.instance_at(0)
+            for k in keys:
+                await inst0.get_rate_limits([_req(k)])
+
+            # pick a victim that owns at least one of the keys
+            owner_hosts = {inst0.get_peer(f"chaos_{k}").host for k in keys}
+            victim_idx = next(i for i in range(3)
+                              if c.peer_at(i) in owner_hosts and i != 0)
+            victim_addr = c.peer_at(victim_idx)
+
+            # real-probe detectors on every survivor (drivable rounds)
+            all_addrs = list(c.addresses)
+            conf = HealthConfig(suspect_after=2, recover_after=2,
+                                heartbeat_timeout=0.5)
+            for i in range(3):
+                if i == victim_idx:
+                    continue
+                inst = c.instance_at(i)
+                mon = HeartbeatMonitor(inst, all_addrs, conf=conf)
+                inst.monitor = mon
+                monitors.append(mon)
+
+            await c.kill_instance(c.nodes.index(
+                next(n for n in c.nodes if n.address == victim_addr)))
+
+            # suspicion window: suspect_after=2 probe rounds
+            for _ in range(2):
+                for mon in monitors:
+                    await mon.probe_once()
+
+            for mon in monitors:
+                snap = mon.snapshot()
+                assert snap["peers"][victim_addr]["state"] == DOWN
+            # every survivor's ring converged to the same 2-node view
+            for n in c.nodes:
+                hosts = sorted(p.host for p in n.instance.peer_list())
+                assert victim_addr not in hosts
+                assert len(hosts) == 2
+
+            # full keyspace serves from every survivor: zero transport
+            # errors, zero per-item errors
+            for n in c.nodes:
+                resps = await n.instance.get_rate_limits(
+                    [_req(k) for k in keys])
+                for k, r in zip(keys, resps):
+                    assert r.error == "", (n.address, k, r.error)
+        finally:
+            for mon in monitors:
+                await mon.stop()
+            await c.stop()
+
+    run(loop, body())
+
+
+def test_partitioned_peer_hits_hint_and_replay_on_heal(loop):
+    """2-node cluster, GLOBAL traffic: an injected partition toward the
+    owner buffers the non-owner's aggregated hits; healing the partition
+    and replaying delivers them — the owner's counter ends where an
+    uninterrupted run would."""
+    async def body():
+        c = await cluster_mod.start_with(["127.0.0.1:0", "127.0.0.1:0"])
+        try:
+            gkey = "gpart"
+            full_key = f"chaos_{gkey}"
+            owner_i = await c.owner_index_of(full_key)
+            nonowner_i = 1 - owner_i
+            owner_addr = c.peer_at(owner_i)
+            non = c.instance_at(nonowner_i)
+
+            FAULTS.seed(5)
+            FAULTS.configure(SEAM_PEER_RPC, drop=1.0, match=owner_addr)
+            gm = non.global_mgr
+            gm.queue_hit(_req(gkey, hits=4, behavior=Behavior.GLOBAL))
+            await gm._send_hits()
+            assert gm.send_errors.get(owner_addr, 0) >= 1
+            assert gm.hints.pending(owner_addr) == 1
+
+            FAULTS.clear()  # heal the partition
+            assert gm.replay_hints(owner_addr) == 1
+            await gm._send_hits()
+            assert gm.hints.pending(owner_addr) == 0
+
+            # the owner's authoritative count saw all 4 hinted hits
+            owner = c.instance_at(owner_i)
+            status = (await owner.get_rate_limits(
+                [_req(gkey, hits=0, behavior=Behavior.GLOBAL)]))[0]
+            assert status.remaining == 1000 - 4
+        finally:
+            FAULTS.clear()
+            await c.stop()
+
+    run(loop, body())
+
+
+def test_cluster_stop_survives_failing_node(loop):
+    """Satellite bugfix: one failing server.stop() used to leak every
+    later node; now all nodes are torn down and the error resurfaces."""
+    async def body():
+        c = await cluster_mod.start_with(["127.0.0.1:0", "127.0.0.1:0"])
+
+        async def explode(grace=None):
+            raise RuntimeError("stop failed")
+
+        c.nodes[0].server.stop = explode
+        closed = []
+        orig_close = c.nodes[1].instance.close
+        c.nodes[1].instance.close = lambda: (closed.append(1),
+                                             orig_close())[1]
+        with pytest.raises(RuntimeError):
+            await c.stop()
+        assert closed == [1]  # the later node was still torn down
+        assert c.nodes == []
+
+    run(loop, body())
+
+
+# ------------------------------------------------------------- legacy soak
 
 
 @pytest.mark.slow

@@ -1,0 +1,192 @@
+"""Daemon graceful-departure suite: the signal-shutdown ordering contract.
+
+Daemon.stop() (daemon.py) must execute its phases in exactly this order —
+stop the detector, drain admitted work, flush the GLOBAL plane, hand the
+owned keyspace to the survivors, take the final snapshot, tear down —
+with every phase exception-tolerant (a failing drain must not skip the
+handoff) and the handoff skipped outright when no surviving ring exists
+(a handoff with no destination must not hang the shutdown).  The phase
+names land in `daemon.shutdown_phases` as they run, which is what these
+tests assert, end to end from a real SIGTERM.
+"""
+
+import asyncio
+import os
+import signal
+from types import SimpleNamespace
+
+import pytest
+
+import gubernator_tpu.daemon as daemon_mod
+from gubernator_tpu.config import DaemonConfig
+from gubernator_tpu.daemon import Daemon
+
+pytestmark = pytest.mark.chaos
+
+
+class FakeGlobalMgr:
+    def __init__(self, calls):
+        self.calls = calls
+
+    async def flush(self):
+        self.calls.append("global_flush")
+
+    def stop(self):
+        self.calls.append("global_stop")
+
+
+class FakeInstance:
+    """Records every shutdown-relevant call, in order."""
+
+    def __init__(self, peers=("self:1", "peer:2", "peer:3"),
+                 drain_raises=False):
+        self.advertise_address = "self:1"
+        self.calls = []
+        self._peers = list(peers)
+        self.drain_raises = drain_raises
+        self.global_mgr = FakeGlobalMgr(self.calls)
+        self.migrations = []
+
+    async def drain(self, timeout):
+        self.calls.append("drain")
+        if self.drain_raises:
+            raise RuntimeError("drain exploded")
+        return True
+
+    def peer_list(self):
+        return [SimpleNamespace(host=h) for h in self._peers]
+
+    async def migrate_keys(self, old_hosts, new_hosts):
+        self.calls.append("migrate")
+        self.migrations.append((list(old_hosts), list(new_hosts)))
+        return {"moved": 0}
+
+    async def save_snapshot(self, path, layout="auto"):
+        self.calls.append("snapshot")
+        return 0
+
+    async def aclose(self):
+        self.calls.append("aclose")
+
+
+class FakeMonitor:
+    def __init__(self, calls):
+        self.calls = calls
+
+    async def stop(self):
+        self.calls.append("monitor_stop")
+
+
+def _daemon(inst, with_monitor=True, with_snapshot_task=False, loop=None):
+    d = Daemon(DaemonConfig(snapshot_dir="/tmp"))
+    d.conf.health.drain_timeout = 2.0
+    d.instance = inst
+    if with_monitor:
+        d.monitor = FakeMonitor(inst.calls)
+    if with_snapshot_task:
+        d._snapshot_task = loop.create_task(asyncio.sleep(600))
+
+        async def snap_once():
+            inst.calls.append("snapshot")
+
+        d._snapshot_once = snap_once
+    return d
+
+
+def test_stop_phase_ordering_with_surviving_ring():
+    async def body():
+        inst = FakeInstance()
+        d = _daemon(inst, with_snapshot_task=True,
+                    loop=asyncio.get_running_loop())
+        await asyncio.wait_for(d.stop(), timeout=10)
+        assert d.shutdown_phases == [
+            "monitor_stop", "drain", "global_flush", "handoff",
+            "snapshot", "teardown",
+        ]
+        # the calls the phases made, in the same order
+        assert inst.calls == [
+            "monitor_stop", "drain", "global_flush", "migrate",
+            "snapshot", "aclose",
+        ]
+        # handoff diffed full membership -> membership minus self
+        assert inst.migrations == [
+            (["self:1", "peer:2", "peer:3"], ["peer:2", "peer:3"])]
+
+    asyncio.run(body())
+
+
+def test_stop_skips_handoff_with_no_surviving_ring():
+    """Last node standing: the handoff has no destination — it must be
+    skipped (recorded as such), not hung until the migrate timeout."""
+    async def body():
+        inst = FakeInstance(peers=("self:1",))
+        d = _daemon(inst)
+        await asyncio.wait_for(d.stop(), timeout=5)
+        assert d.shutdown_phases == [
+            "monitor_stop", "drain", "global_flush", "handoff_skipped",
+            "teardown",
+        ]
+        assert "migrate" not in inst.calls
+        assert inst.calls[-1] == "aclose"
+
+    asyncio.run(body())
+
+
+def test_stop_phase_failure_does_not_skip_later_phases():
+    async def body():
+        inst = FakeInstance(drain_raises=True)
+        d = _daemon(inst)
+        await asyncio.wait_for(d.stop(), timeout=10)
+        # drain blew up, but the flush, the handoff and the teardown all
+        # still ran — a failed phase must never strand the keyspace
+        assert d.shutdown_phases == [
+            "monitor_stop", "drain", "global_flush", "handoff", "teardown"]
+        assert inst.calls[-2:] == ["migrate", "aclose"]
+
+    asyncio.run(body())
+
+
+def test_stop_without_instance_is_a_noop_walk():
+    async def body():
+        d = Daemon(DaemonConfig())
+        await asyncio.wait_for(d.stop(), timeout=5)
+        assert d.shutdown_phases == [
+            "monitor_stop", "drain", "global_flush", "teardown"]
+
+    asyncio.run(body())
+
+
+def test_sigterm_drives_the_full_graceful_stop(monkeypatch):
+    """End to end: a real SIGTERM to the process walks _amain into
+    Daemon.stop() and the phase contract holds."""
+    built = []
+
+    class WiredDaemon(Daemon):
+        async def start(self):
+            self.instance = FakeInstance(peers=("self:1", "peer:2"))
+            self.monitor = FakeMonitor(self.instance.calls)
+            built.append(self)
+
+    monkeypatch.setattr(daemon_mod, "Daemon", WiredDaemon)
+
+    async def body():
+        loop = asyncio.get_running_loop()
+        task = loop.create_task(daemon_mod._amain(DaemonConfig()))
+        try:
+            await asyncio.sleep(0.05)  # let _amain install its handlers
+            os.kill(os.getpid(), signal.SIGTERM)
+            await asyncio.wait_for(task, timeout=15)
+        finally:
+            task.cancel()
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                try:
+                    loop.remove_signal_handler(sig)
+                except (ValueError, RuntimeError):
+                    pass
+
+    asyncio.run(body())
+    (d,) = built
+    assert d.shutdown_phases == [
+        "monitor_stop", "drain", "global_flush", "handoff", "teardown"]
+    assert d.instance.calls == [
+        "monitor_stop", "drain", "global_flush", "migrate", "aclose"]
