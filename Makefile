@@ -1,7 +1,7 @@
 # Dev targets (the reference Makefile:1-15 has only release/docker; we add
 # the working set).
 
-.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos test-obs test-chaos proto bench docker lint cluster
+.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos test-obs test-chaos test-analytics proto bench bench-smoke docker lint cluster
 
 test:
 	python -m pytest tests/ -x -q
@@ -46,11 +46,23 @@ test-obs:
 test-chaos:
 	python -m pytest tests/ -x -q -m "chaos and not slow"
 
+# the traffic-analytics slice: device stats reduction vs the numpy oracle,
+# Zipf hot-key top-K precision, SLO burn-rate alerting, analytics-off
+# zero-overhead census.  Part of tier-1 (`test-core` picks it up too).
+test-analytics:
+	python -m pytest tests/ -x -q -m "analytics and not slow"
+
 proto:
 	cd gubernator_tpu/api/proto && protoc --python_out=. gubernator.proto peers.proto
 
 bench:
 	python bench.py
+
+# bench-regression gate: fresh CPU smoke run of bench.py diffed against
+# the best prior BENCH_r*.json cpu numbers (10% noise floor); fails loudly
+# when e2e/device decisions-per-sec regress.
+bench-smoke:
+	python scripts/bench_compare.py
 
 docker:
 	docker build -t gubernator-tpu:latest .

@@ -10,6 +10,7 @@ conversion rules grpc-gateway uses):
   GET  /v1/HealthCheck
   GET  /metrics            prometheus text format (main.go:113-116)
   GET  /v1/admin/debug     runtime introspection snapshot (JSON)
+  GET  /v1/admin/topk      traffic analytics: hot-key top-K + tenants (JSON)
   POST /v1/admin/profile   arm a jax.profiler capture of the next N drains
 
 Unlike the gateway in the reference (which dials the node's own gRPC port
@@ -137,6 +138,23 @@ def build_app(instance: Instance) -> web.Application:
     async def admin_debug(request: web.Request) -> web.Response:
         return web.json_response(build_debug_snapshot(instance))
 
+    async def admin_topk(request: web.Request) -> web.Response:
+        # hot-key view of the traffic analytics (cmd/cli.py `top`):
+        # 404 when the subsystem is off so the CLI can say why
+        an = getattr(instance, "analytics", None)
+        if an is None:
+            return web.json_response(
+                {"error": "analytics disabled (set GUBER_ANALYTICS=1)",
+                 "code": 12}, status=404)
+        try:
+            n = int(request.query.get("n", an.conf.topk))
+        except ValueError:
+            return web.json_response({"error": "invalid n", "code": 3},
+                                     status=400)
+        snap = an.snapshot()
+        snap["topk"] = an.topk_snapshot(n)
+        return web.json_response(snap)
+
     async def admin_profile(request: web.Request) -> web.Response:
         body = {}
         if request.can_read_body:
@@ -167,6 +185,7 @@ def build_app(instance: Instance) -> web.Application:
     app.router.add_get("/v1/admin/snapshot", admin_snapshot)
     app.router.add_post("/v1/admin/restore", admin_restore)
     app.router.add_get("/v1/admin/debug", admin_debug)
+    app.router.add_get("/v1/admin/topk", admin_topk)
     app.router.add_post("/v1/admin/profile", admin_profile)
     return app
 

@@ -13,11 +13,17 @@ The snapshot/restore subcommands drive the daemon's HTTP admin plane
   python -m gubernator_tpu.cmd.cli restore  <http-addr> arena.snap
                                             [--rebase-to-now]
   python -m gubernator_tpu.cmd.cli debug    <http-addr>      # introspection
+  python -m gubernator_tpu.cmd.cli top      <http-addr> [--watch N]
+  python -m gubernator_tpu.cmd.cli slo      <http-addr> [--watch N]
 
 `debug` pretty-prints the daemon's /v1/admin/debug snapshot (arena
 occupancy, admission queue, breaker states, congestion window, per-stage
 latency quantiles, recent traces).  `load --http-address` prints the same
-per-stage p50/p95/p99 table every 10 rounds while hammering.
+per-stage p50/p95/p99 table every 10 rounds while hammering.  `top` is
+the hot-key live view backed by /v1/admin/topk (device count-min sketch +
+candidate top-K, observability/analytics.py); `slo` renders the
+multi-window burn rates of the SLO engine.  Both take `--watch SECONDS`
+to refresh in place.
 
 For compatibility, a bare address (no subcommand) runs load generation.
 """
@@ -149,6 +155,13 @@ def cmd_debug(args) -> int:
           f"standalone={snap.get('standalone')}")
     if eng:
         print("engine:", " ".join(f"{k}={v}" for k, v in sorted(eng.items())))
+        # arena pressure in one line: the live/expired/free slot breakdown
+        # next to capacity, so "is the arena full of dead weight?" needs
+        # no mental arithmetic
+        cap = eng.get("capacity") or 1
+        print(f"arena: {eng.get('live', 0)} live / "
+              f"{eng.get('expired', 0)} expired / {eng.get('free', 0)} free "
+              f"of {cap} slots ({100.0 * eng.get('live', 0) / cap:.1f}% live)")
     adm = snap.get("admission")
     if adm:
         print(f"admission: pending={adm['pending']} "
@@ -185,6 +198,22 @@ def cmd_debug(args) -> int:
     if pipe:
         print("pipeline:", " ".join(
             f"{k}={v}" for k, v in sorted(pipe.items())))
+    an = snap.get("analytics")
+    if an:
+        tot = an.get("totals", {})
+        occ = an.get("occupancy", {})
+        print(f"analytics: decisions={tot.get('decisions', 0)} "
+              f"over_limit={tot.get('over_limit', 0)} "
+              f"inits={tot.get('inits', 0)} "
+              f"device_occupancy={occ.get('live', 0)} live/"
+              f"{occ.get('expired', 0)} expired")
+    slo = snap.get("slo")
+    if slo:
+        for name, obj in sorted(slo.get("burn_rates", {}).items()):
+            state = "FIRING" if obj.get("firing") else "ok"
+            wins = " ".join(f"{w}={b}" for w, b in
+                            sorted(obj.get("windows", {}).items()))
+            print(f"slo {name}: {state} budget={obj.get('budget')} {wins}")
     _print_stage_table(snap.get("stages", {}))
     tracing = snap.get("tracing")
     if tracing:
@@ -203,10 +232,101 @@ def cmd_debug(args) -> int:
     return 0
 
 
+def _watch_loop(once, interval: float) -> int:
+    """Run `once` every `interval` seconds until ^C (interval 0 = single
+    shot).  The live-view plumbing shared by `top` and `slo`."""
+    import time as _time
+    if not interval:
+        return once()
+    try:
+        while True:
+            rc = once()
+            if rc:
+                return rc
+            _time.sleep(interval)
+            print()
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_top(args) -> int:
+    """Hot-key live view from /v1/admin/topk (traffic analytics)."""
+    def once() -> int:
+        url = f"{_http_base(args.address)}/v1/admin/topk?n={args.n}"
+        try:
+            with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+                snap = json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            print(f"topk fetch failed: "
+                  f"{e.read().decode('utf-8', 'replace')}", file=sys.stderr)
+            return 1
+        except Exception as e:
+            print(f"topk fetch failed: {e}", file=sys.stderr)
+            return 1
+        tot = snap.get("totals", {})
+        occ = snap.get("occupancy", {})
+        print(f"decisions={tot.get('decisions', 0)} "
+              f"hits={tot.get('hits', 0)} "
+              f"over_limit={tot.get('over_limit', 0)} "
+              f"inits={tot.get('inits', 0)} drains={tot.get('drains', 0)} "
+              f"arena={occ.get('live', 0)} live/"
+              f"{occ.get('expired', 0)} expired")
+        rows = snap.get("topk", [])
+        if not rows:
+            print("(no hot keys yet)")
+        else:
+            print(f"{'score':>10}{'hits':>10}{'over':>8}  key")
+            for r in rows:
+                print(f"{r['score']:>10}{r['hits']:>10}{r['over']:>8}  "
+                      f"{r['key']}")
+        tenants = snap.get("tenants", {})
+        if tenants:
+            print("tenants:")
+            for name, t in sorted(tenants.items(),
+                                  key=lambda kv: -kv[1]["decisions"]):
+                print(f"  {name}: decisions={t['decisions']} "
+                      f"hits={t['hits']} over_limit={t['over_limit']}")
+        return 0
+
+    return _watch_loop(once, args.watch)
+
+
+def cmd_slo(args) -> int:
+    """SLO burn-rate live view from the debug snapshot's slo section."""
+    def once() -> int:
+        try:
+            snap = _fetch_debug(args.address, timeout=args.timeout)
+        except Exception as e:
+            print(f"debug fetch failed: {e}", file=sys.stderr)
+            return 1
+        slo = snap.get("slo")
+        if not slo:
+            print("slo engine disabled (set GUBER_SLO=1)", file=sys.stderr)
+            return 1
+        obj = slo.get("objectives", {})
+        print(f"objectives: drain_p99_ms={obj.get('drain_p99_ms')} "
+              f"drain_budget={obj.get('drain_budget')} "
+              f"shed_budget={obj.get('shed_budget')} "
+              f"availability={obj.get('availability')}")
+        wins = slo.get("burn_windows", [])
+        print("windows: " + ", ".join(
+            f"{w['window_s']:.0f}s>{w['threshold']}" for w in wins))
+        for name, o in sorted(slo.get("burn_rates", {}).items()):
+            state = "FIRING" if o.get("firing") else "ok"
+            parts = " ".join(f"{w}={b}" for w, b in
+                             sorted(o.get("windows", {}).items(),
+                                    key=lambda kv: int(kv[0][:-1])))
+            print(f"{name:<14}{state:<8}budget={o.get('budget'):<8} {parts}")
+        return 0
+
+    return _watch_loop(once, args.watch)
+
+
 def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     # compatibility: a bare address (or nothing) runs load generation
-    if not argv or argv[0] not in ("load", "snapshot", "restore", "debug"):
+    if not argv or argv[0] not in ("load", "snapshot", "restore", "debug",
+                                   "top", "slo"):
         argv.insert(0, "load")
 
     p = argparse.ArgumentParser("gubernator-tpu-cli")
@@ -242,6 +362,21 @@ def main(argv=None) -> None:
                     help="also dump the raw snapshot JSON")
     pd.add_argument("--timeout", type=float, default=5.0)
 
+    pt = sub.add_parser("top", help="hot-key top-K live view "
+                        "(traffic analytics)")
+    pt.add_argument("address", help="daemon HTTP address (host:port)")
+    pt.add_argument("-n", type=int, default=20,
+                    help="number of hot keys to show")
+    pt.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                    help="refresh every SECONDS until ^C (0 = one shot)")
+    pt.add_argument("--timeout", type=float, default=5.0)
+
+    po = sub.add_parser("slo", help="SLO burn-rate live view")
+    po.add_argument("address", help="daemon HTTP address (host:port)")
+    po.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                    help="refresh every SECONDS until ^C (0 = one shot)")
+    po.add_argument("--timeout", type=float, default=5.0)
+
     args = p.parse_args(argv)
     if args.cmd == "snapshot":
         sys.exit(cmd_snapshot(args))
@@ -249,6 +384,10 @@ def main(argv=None) -> None:
         sys.exit(cmd_restore(args))
     if args.cmd == "debug":
         sys.exit(cmd_debug(args))
+    if args.cmd == "top":
+        sys.exit(cmd_top(args))
+    if args.cmd == "slo":
+        sys.exit(cmd_slo(args))
     try:
         asyncio.run(_load(args.address, args.count, args.concurrency,
                           http_address=args.http_address))

@@ -170,6 +170,105 @@ class HealthConfig:
 
 
 @dataclass
+class AnalyticsConfig:
+    """Device-computed traffic analytics (ops/analytics.py +
+    observability/analytics.py): per-drain outcome counts, count-min
+    sketch + hot-key top-K, per-tenant usage rows, arena occupancy/churn.
+    Defaults read GUBER_ANALYTICS_* at construction (trace_sample
+    pattern) so library embedders get the same knobs as the daemon.
+    No reference analog — the reference exposes only cache hit/miss."""
+
+    enabled: bool = field(
+        default_factory=lambda: env_bool("GUBER_ANALYTICS", False))
+    # Candidate rows per shard per drain AND the host's rolling table size.
+    topk: int = field(
+        default_factory=lambda: env_int("GUBER_ANALYTICS_TOPK", 32))
+    # Count-min sketch geometry (per shard, resident on device).
+    sketch_width: int = field(
+        default_factory=lambda: env_int("GUBER_ANALYTICS_SKETCH_WIDTH", 2048))
+    sketch_depth: int = field(
+        default_factory=lambda: env_int("GUBER_ANALYTICS_SKETCH_DEPTH", 4))
+    # Sketch + rolling-table halving cadence (ms); 0 disables decay.
+    decay_ms: int = field(
+        default_factory=lambda: env_int("GUBER_ANALYTICS_DECAY_MS", 10_000,
+                                        minimum=0))
+    # Distinct tenants tracked on device; id 0 is the shared
+    # "other/unattributed" row (native-fastpath lanes land there).
+    tenant_slots: int = field(
+        default_factory=lambda: env_int("GUBER_ANALYTICS_TENANTS", 64,
+                                        minimum=2))
+    # Hot-key score = hits + over_weight * over_limit decisions: keys
+    # burning their limit rank above merely chatty ones.
+    over_weight: int = field(
+        default_factory=lambda: env_int("GUBER_ANALYTICS_OVER_WEIGHT", 4,
+                                        minimum=0))
+
+    def validate(self) -> None:
+        from gubernator_tpu.ops import analytics as _ops
+        if self.sketch_depth > _ops.MAX_SKETCH_DEPTH:
+            raise ValueError(
+                f"Analytics.sketch_depth cannot exceed {_ops.MAX_SKETCH_DEPTH}")
+        if self.topk < 1 or self.sketch_width < 16:
+            raise ValueError("Analytics.topk >= 1 and sketch_width >= 16 required")
+
+
+@dataclass
+class SLOConfig:
+    """SLO burn-rate engine (observability/analytics.py SLOEngine):
+    multi-window multi-burn-rate alerting over configured objectives.
+    Each burn window pairs with a short window (window/12) — an alert
+    fires only when BOTH exceed the threshold (Google SRE workbook ch.5),
+    so a burst trips fast windows and a slow leak trips long ones."""
+
+    enabled: bool = field(
+        default_factory=lambda: env_bool("GUBER_SLO", False))
+    # drain p99 objective: fraction of drains allowed over the target.
+    drain_p99_ms: float = field(
+        default_factory=lambda: env_float("GUBER_SLO_DRAIN_P99_MS", 100.0,
+                                          minimum=1e-3))
+    drain_budget: float = field(
+        default_factory=lambda: env_float("GUBER_SLO_DRAIN_BUDGET", 0.01))
+    # shed-rate objective: fraction of decisions allowed to shed.
+    shed_budget: float = field(
+        default_factory=lambda: env_float("GUBER_SLO_SHED_BUDGET", 0.01))
+    # availability objective: 1 - availability is the error budget over
+    # decisions (sheds + errors count as bad).
+    availability: float = field(
+        default_factory=lambda: env_float("GUBER_SLO_AVAILABILITY", 0.999))
+    # "window_seconds:threshold" pairs, comma-separated.  The defaults are
+    # the SRE-workbook ladder scaled to minutes (page = 14.4x over 5m,
+    # ticket = 6x over 30m, trend = 1x over 2h).
+    burn_windows: str = field(
+        default_factory=lambda: _env("GUBER_SLO_BURN_WINDOWS",
+                                     "300:14.4,1800:6,7200:1"))
+
+    def windows(self) -> List[tuple]:
+        """Parse burn_windows → [(seconds, threshold)], skipping malformed
+        pairs (observability knobs must never crash a boot)."""
+        out = []
+        for part in self.burn_windows.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                w, _, t = part.partition(":")
+                sec, thr = float(w), float(t) if t else 1.0
+                if sec > 0 and thr > 0:
+                    out.append((sec, thr))
+            except ValueError:
+                continue
+        return out or [(300.0, 14.4), (1800.0, 6.0), (7200.0, 1.0)]
+
+    def validate(self) -> None:
+        if not (0.0 < self.drain_budget <= 1.0):
+            raise ValueError("SLO.drain_budget must be in (0, 1]")
+        if not (0.0 < self.shed_budget <= 1.0):
+            raise ValueError("SLO.shed_budget must be in (0, 1]")
+        if not (0.0 < self.availability < 1.0):
+            raise ValueError("SLO.availability must be in (0, 1)")
+
+
+@dataclass
 class PeerInfo:
     # reference etcd.go:29-32
     address: str = ""
@@ -187,6 +286,8 @@ class Config:
     engine: EngineConfig = field(default_factory=EngineConfig)
     qos: QoSConfig = field(default_factory=QoSConfig)
     health: HealthConfig = field(default_factory=HealthConfig)
+    analytics: AnalyticsConfig = field(default_factory=AnalyticsConfig)
+    slo: SLOConfig = field(default_factory=SLOConfig)
     # advertise address used for self-identification in the peer ring
     advertise_address: str = ""
     # Request tracing (observability/tracing.py): probability a request
@@ -257,6 +358,8 @@ class DaemonConfig:
     engine: EngineConfig = field(default_factory=EngineConfig)
     qos: QoSConfig = field(default_factory=QoSConfig)
     health: HealthConfig = field(default_factory=HealthConfig)
+    analytics: AnalyticsConfig = field(default_factory=AnalyticsConfig)
+    slo: SLOConfig = field(default_factory=SLOConfig)
 
     @property
     def k8s_enabled(self) -> bool:
@@ -468,5 +571,13 @@ def config_from_env(env_file: Optional[str] = None) -> DaemonConfig:
                                 h.drain_timeout * 1000.0,
                                 minimum=0.0) / 1000.0
     h.validate()
+
+    # Traffic analytics + SLO engine: the default_factory fields already
+    # read GUBER_ANALYTICS_*/GUBER_SLO_* — rebuild after load_env_file so
+    # an env-file sets them too, then validate.
+    c.analytics = AnalyticsConfig()
+    c.analytics.validate()
+    c.slo = SLOConfig()
+    c.slo.validate()
 
     return c

@@ -27,6 +27,7 @@ from gubernator_tpu.core.interval import ArmedInterval
 from gubernator_tpu.core.pipeline import DispatchPipeline
 from gubernator_tpu.net.faults import FAULTS, SEAM_ENGINE_DISPATCH
 from gubernator_tpu.qos import interleave_by_tenant, shed_response
+from gubernator_tpu.qos.fairness import tenant_of
 
 
 class WindowBatcher:
@@ -38,6 +39,8 @@ class WindowBatcher:
         lockstep_clock=None,
         qos=None,
         tracer=None,
+        analytics=None,
+        slo=None,
     ):
         self.engine = engine
         self.behaviors = behaviors or BehaviorConfig()
@@ -98,7 +101,7 @@ class WindowBatcher:
         self.pipeline: Optional[DispatchPipeline] = DispatchPipeline(
             engine, self._executor, metrics,
             lockstep=lockstep_clock is not None, qos=qos, tracer=tracer,
-            profile=self.profile)
+            profile=self.profile, analytics=analytics, slo=slo)
         if not self.pipeline.enabled:
             self.pipeline = None
         elif self.pipeline.lockstep:
@@ -227,7 +230,7 @@ class WindowBatcher:
             # tenant-fair slotting: the prefix cut below must not hand every
             # lane to one hot tenant's burst (stable within tenant, so
             # per-key order is preserved — same key => same tenant)
-            ok = interleave_by_tenant(ok, lambda t: t[0].name)
+            ok = interleave_by_tenant(ok, lambda t: tenant_of(t[0]))
         fit = self.engine.max_window_prefix([w[0] for w in ok])
         if self.qos is not None:
             fit = min(fit, self._window_limit())
@@ -390,7 +393,7 @@ class WindowBatcher:
         self._pending = []
         if self.qos is not None:
             if self.qos.fair_slotting:
-                window = interleave_by_tenant(window, lambda t: t[0].name)
+                window = interleave_by_tenant(window, lambda t: tenant_of(t[0]))
             # the congestion window caps decisions-per-dispatch: the excess
             # stays queued for the next cycle (and re-arms the timer so it
             # cannot strand if no further submit arrives)

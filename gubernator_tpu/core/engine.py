@@ -1397,6 +1397,56 @@ class RateLimitEngine:
                                    else n_windows)
         return words, limits, mism, gfused
 
+    # ------------------------------------------------------ traffic analytics
+    #
+    # The per-drain stats reduction (ops/analytics.py) runs as its OWN
+    # executable over the drain's inputs/outputs, so the drain builders
+    # above stay byte-identical whether analytics is on or off — the
+    # disabled serving path is provably unchanged (tests/test_analytics.py
+    # census).  The reduction is collective-free: each shard emits its own
+    # stats row and the host merges its local blocks, so it is safe to
+    # dispatch outside the lockstep collective contract (every process
+    # still issues it at the same sequence position because the enabled
+    # flag comes from config, identical everywhere).
+
+    _an_conf = None
+    _an_sketch = None
+
+    def enable_analytics(self, conf) -> None:
+        """Allocate the resident per-shard count-min sketch and record the
+        reduction geometry (config.AnalyticsConfig).  Call once at wiring
+        time (core/service.py), before serving starts."""
+        self._an_conf = conf
+        self._an_sketch = self._put_sharded(
+            np.zeros((self.num_local_shards, conf.sketch_depth,
+                      conf.sketch_width), np.int64), np.int64)
+
+    def analytics_dispatch(self, packed, words, tenants, now: int,
+                           decay: int):
+        """Per-drain stats reduction: consume the drain's compact request
+        stack (host [K, S_local, B, 2] — re-staged host→device, the cheap
+        direction), its resident response words i64[K, S, B], and the
+        host-staged tenant lanes i32[K, S_local, B]; update the resident
+        sketch in place (donated carry) and return the UN-FETCHED stats
+        array i64[S, V] (fetch local rows with _fetch_local, overlapped
+        with the drain's own fetch — no extra device→host round trip).
+        decay=1 halves the sketch before accumulating (host cadence)."""
+        conf = self._an_conf
+        if self.multiprocess:
+            packed = self._sharded_in_stacked(np.ascontiguousarray(packed))
+            tenants = self._sharded_in_stacked(np.ascontiguousarray(tenants))
+            now_in = self._repl_in(np.int64(now))
+            decay_in = self._repl_in(np.int64(decay))
+        else:
+            now_in = jnp.int64(now)
+            decay_in = jnp.int64(decay)
+        fn = _compiled_analytics_reduce(self.mesh, conf.sketch_depth,
+                                        conf.sketch_width, conf.tenant_slots,
+                                        conf.topk, conf.over_weight)
+        self._an_sketch, stats = fn(self._an_sketch, self.state.expire,
+                                    packed, words, tenants, now_in, decay_in)
+        return stats
+
     def process(
         self,
         requests: Sequence[RateLimitReq],
@@ -2453,6 +2503,38 @@ def _drain_scan(mesh: Mesh, pallas: bool, c32xla: bool, fused: bool,
     else:
         st, (words, limits, mism) = lax.scan(body, st, (packed, nows))
     return st, words, limits, mism
+
+
+@lru_cache(maxsize=None)
+def _compiled_analytics_reduce(mesh: Mesh, depth: int, width: int,
+                               tenant_slots: int, topk: int,
+                               over_weight: int):
+    """The traffic-analytics reduction (ops/analytics.py shard_stats) as a
+    collective-free shard_map'd executable: per shard, fold one drain's
+    (packed, words, tenants) into the resident count-min sketch (donated
+    carry) and emit one flat stats row.  Deliberately NOT part of the
+    drain builders: keyed only on geometry, it composes unchanged with
+    every drain lowering (compact32-XLA, fused Pallas, GLOBAL-composed
+    mesh) and leaves their jaxprs byte-identical when analytics is off."""
+    from gubernator_tpu.ops import analytics as ops_analytics
+
+    def shard_fn(sketch, expire, packed, words, tenants, now, decay):
+        # Block shapes: sketch [1, D, W]; expire [1, C]; packed
+        # [K, 1, B, 2]; words [K, 1, B]; tenants [K, 1, B]; now/decay [].
+        sk, stats = ops_analytics.shard_stats(
+            sketch[0], packed[:, 0], words[:, 0], tenants[:, 0], expire[0],
+            now, decay, tenant_slots=tenant_slots, topk=topk,
+            over_weight=over_weight)
+        return sk[None], stats[None]
+
+    sharded = _compat_shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), stacked_spec(),
+                  stacked_spec(), stacked_spec(), P(), P()),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
 
 
 def _compiled_pipeline_step_global(mesh: Mesh):

@@ -66,6 +66,7 @@ from gubernator_tpu.core.engine import PIPELINE_K_BUCKETS
 from gubernator_tpu.observability.tracing import current_context
 from gubernator_tpu.ops import kernel
 from gubernator_tpu.qos import interleave_by_tenant
+from gubernator_tpu.qos.fairness import tenant_of
 
 log = logging.getLogger("gubernator.pipeline")
 
@@ -318,7 +319,8 @@ class _GlobalJob:
 
 
 class _DrainResult:
-    __slots__ = ("words", "limits", "mism", "gfused", "staged", "fallback",
+    __slots__ = ("words", "limits", "mism", "gfused", "stats", "stats_host",
+                 "an_decay", "staged", "fallback",
                  "leftover", "now", "n_decisions", "n_lanes", "k_used",
                  "error", "started", "ring_peers",
                  "pack_done", "dispatch_done", "fetch_start", "fetch_done",
@@ -329,6 +331,11 @@ class _DrainResult:
         self.limits = None
         self.mism = None
         self.gfused = None
+        # traffic analytics (ops/analytics.py): the un-fetched device stats
+        # array, its host copy, and whether this drain's reduction decayed
+        self.stats = None
+        self.stats_host = None
+        self.an_decay = 0
         self.staged = []
         self.fallback = []
         self.leftover = []
@@ -363,8 +370,16 @@ class DispatchPipeline:
     def __init__(self, engine, engine_executor: ThreadPoolExecutor,
                  metrics=None, k_max: int = PIPELINE_K_BUCKETS[-1],
                  depth: int = 3, lockstep: Optional[bool] = None,
-                 qos=None, tracer=None, profile=None):
+                 qos=None, tracer=None, profile=None, analytics=None,
+                 slo=None):
         self.engine = engine
+        # traffic analytics + SLO engine (observability/analytics.py), or
+        # None: the disabled serving path pays exactly ONE attribute check
+        # per DRAIN (not per request) and dispatches nothing extra — the
+        # drain executables are byte-identical either way
+        # (tests/test_analytics.py census).
+        self.analytics = analytics
+        self.slo = slo
         # observability: span recorder (None = tracing off everywhere) and
         # the armable jax.profiler capture shared with the batcher
         self.tracer = tracer
@@ -581,7 +596,7 @@ class DispatchPipeline:
                     # not occupy every lane of the drain (stable within
                     # tenant, so per-key order is preserved)
                     singles = interleave_by_tenant(
-                        singles, lambda t: t[0].name)
+                        singles, lambda t: tenant_of(t[0]))
                 # the congestion window caps decisions-per-drain; the
                 # excess stays queued and rides the next pump (completion
                 # callbacks re-pump with force=True)
@@ -803,6 +818,8 @@ class DispatchPipeline:
             _, outs = fut.result()
         except Exception as e:  # fetch/demux failed: fail THIS drain's jobs
             log.exception("pipeline fetch failed")
+            if self.slo is not None:  # availability evidence: errored work
+                self.slo.observe_error(max(1, res.n_decisions))
             for job in res.staged:
                 self._resolve_error(job, e)
             self._pump(force=True)
@@ -829,6 +846,18 @@ class DispatchPipeline:
         if self.qos is not None and res.n_decisions:
             self.qos.congestion.observe_drain(
                 drain_wall, depth=max(1, res.k_used))
+        # traffic analytics + SLO evidence, from the same completion clock
+        # the AIMD and stage histograms read
+        if self.analytics is not None and res.stats_host is not None:
+            try:
+                self.analytics.ingest(res.stats_host, res.an_decay)
+            except Exception:
+                log.exception("analytics ingest failed")
+        if self.slo is not None and (res.n_decisions or not self.lockstep):
+            # idle lockstep ticks carry no serving evidence — feeding
+            # their (fast, empty) drains into drain_p99 would let a
+            # saturated-but-slow server hide behind idle ticks
+            self.slo.observe_drain(drain_wall, res.n_decisions)
         if self.metrics is not None:
             m = self.metrics
             m.window_count.inc()
@@ -1142,6 +1171,13 @@ class DispatchPipeline:
                 res.words, res.limits, res.mism = words, limits, mism
                 if gjob is not None:
                     res.gfused = gfused
+            # UNCONDITIONAL in lockstep (not gated on local staging): the
+            # analytics executable is collective-free but still a global
+            # computation — every process must issue it at the same
+            # sequence position.  The decay flag derives from the tick's
+            # cluster-agreed `now`, so it too is identical everywhere.
+            if self.analytics is not None:
+                self._analytics_dispatch(res, packed, words, now)
         elif k_used:  # an all-forwarded drain has nothing to dispatch
             kb = next(b for b in self._k_buckets if b >= k_used)
             try:
@@ -1160,6 +1196,8 @@ class DispatchPipeline:
             except Exception:
                 pass  # fetch path will block instead
             res.words, res.limits, res.mism = words, limits, mism
+            if self.analytics is not None:
+                self._analytics_dispatch(res, packed, words, now)
         else:
             native.commit()  # nothing staged: empty by construction
         res.dispatch_done = time.monotonic()
@@ -1178,6 +1216,57 @@ class DispatchPipeline:
         self.decisions_staged += res.n_decisions
         self.lanes_staged += res.n_lanes
         return res
+
+    def _analytics_dispatch(self, res: _DrainResult, packed, words,
+                            now: int) -> None:
+        """Stage the tenant lanes + slot labels for this drain and issue
+        the stats reduction (engine thread; analytics enabled only).
+
+        Tenant ids come from the fairness tenant (the request `name`,
+        qos/fairness.tenant_of) of each staged ListJob lane; RpcJob lanes
+        stay id 0 ("other") — the native fastpath never materializes key
+        strings on the host.  The reduction consumes the drain's own
+        packed stack (re-staged host→device, the cheap direction) and its
+        RESIDENT response words, and its stats output joins the drain
+        result's async copies — zero extra device→host round trips.  Any
+        failure here is logged and dropped: analytics must never fail a
+        drain."""
+        from gubernator_tpu.ops.analytics import _SLOT_MASK
+        eng = self.engine
+        try:
+            an = self.analytics
+            S = eng.num_local_shards
+            kd = int(words.shape[0])
+            tenants = np.zeros((kd, S, eng.batch_per_shard), np.int32)
+            for job in res.staged:
+                reqs = getattr(job, "reqs", None)
+                rows = getattr(job, "row", None)
+                if reqs is None or rows is None:
+                    continue
+                for i in range(job.n):
+                    row = int(rows[i])
+                    if row < 0:
+                        continue
+                    k, s = divmod(row, S)
+                    if k >= kd:
+                        continue
+                    lane = int(job.lane[i])
+                    r = reqs[i]
+                    tenants[k, s, lane] = an.tenant_id(tenant_of(r))
+                    slot = int(packed[k, s, lane, 0] & _SLOT_MASK) - 1
+                    if slot >= 0:
+                        an.label_slot(s, slot, r.hash_key())
+            decay = an.decay_flag(now)
+            stats = eng.analytics_dispatch(packed[:kd], words, tenants,
+                                           now, decay)
+            try:
+                stats.copy_to_host_async()
+            except Exception:
+                pass  # fetch path will block instead
+            res.stats = stats
+            res.an_decay = decay
+        except Exception:
+            log.exception("analytics reduction failed (drain unaffected)")
 
     # ------------------------------------------------------------ fetch side
 
@@ -1205,6 +1294,13 @@ class DispatchPipeline:
             # this process's GLOBAL response rows [S_local, Bg, 4], indexed
             # exactly as the round-robin staging wrote (shard, lane)
             gflat = eng._fetch_local(res.gfused)
+        if res.stats is not None:
+            # analytics stats ride the same fetch stage as the drain's own
+            # outputs (their async copy started at dispatch)
+            try:
+                res.stats_host = eng._fetch_local(res.stats)
+            except Exception:
+                log.exception("analytics stats fetch failed")
         outs = [job.finish_global(gflat) if isinstance(job, _GlobalJob)
                 else job.finish(self, wflat, clflat, res.now)
                 for job in res.staged]

@@ -92,6 +92,26 @@ class Instance:
         if self.conf.qos.enabled:
             self.qos = QoSManager(self.conf.qos, metrics=self.metrics)
             self.metrics.watch_qos(self.qos)
+        # Traffic analytics + SLO burn-rate engine (observability/
+        # analytics.py).  Off by default: the pipeline then holds None and
+        # the serving path is byte-identical to the seed (one attribute
+        # check per drain).  The enabled flag comes from config, so every
+        # mesh process makes the same choice — the analytics executable is
+        # part of each drain's issue sequence when on.
+        self.analytics = None
+        self.slo = None
+        if self.conf.analytics.enabled:
+            from gubernator_tpu.observability.analytics import TrafficAnalytics
+            self.conf.analytics.validate()
+            self.analytics = TrafficAnalytics(self.conf.analytics,
+                                              metrics=self.metrics)
+            self.engine.enable_analytics(self.conf.analytics)
+        if self.conf.slo.enabled:
+            from gubernator_tpu.observability.analytics import SLOEngine
+            self.conf.slo.validate()
+            self.slo = SLOEngine(self.conf.slo)
+        if self.analytics is not None or self.slo is not None:
+            self.metrics.watch_analytics(self.analytics, self.slo)
         self.mesh_mode = mesh_peers is not None
         clock = None
         if self.mesh_mode:
@@ -104,7 +124,8 @@ class Instance:
                                   self.conf.behaviors.batch_wait)
         self.batcher = WindowBatcher(self.engine, self.conf.behaviors,
                                      self.metrics, lockstep_clock=clock,
-                                     qos=self.qos, tracer=self.tracer)
+                                     qos=self.qos, tracer=self.tracer,
+                                     analytics=self.analytics, slo=self.slo)
         self.global_mgr = GlobalManager(
             self.conf.behaviors, self, self.metrics, log,
             health=self.conf.health)

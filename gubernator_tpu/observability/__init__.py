@@ -7,9 +7,16 @@ Public API — import from here, not the submodules:
   * `Tracer` / `get_tracer` — the lightweight span recorder and the
     process-default instance configured from GUBER_TRACE_* (tracing.py);
   * `ProfileCapture` / `build_debug_snapshot` — on-demand device capture
-    and the `/v1/admin/debug` operator view (introspect.py).
+    and the `/v1/admin/debug` operator view (introspect.py);
+  * `TrafficAnalytics` / `SLOEngine` — host side of the device-computed
+    traffic analytics (hot-key top-K, per-tenant accounting) and the
+    multi-window burn-rate alerting engine (analytics.py).
 """
 
+from gubernator_tpu.observability.analytics import (
+    SLOEngine,
+    TrafficAnalytics,
+)
 from gubernator_tpu.observability.introspect import (
     ProfileCapture,
     build_debug_snapshot,
@@ -34,8 +41,10 @@ __all__ = [
     "NOOP_SPAN",
     "ProfileCapture",
     "STAGES",
+    "SLOEngine",
     "SpanContext",
     "Tracer",
+    "TrafficAnalytics",
     "build_debug_snapshot",
     "current_context",
     "get_tracer",

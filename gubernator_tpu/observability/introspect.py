@@ -169,6 +169,18 @@ def build_debug_snapshot(instance) -> dict:
             "fused_serving": pipe.fused_serving,
             "lockstep": pipe.lockstep,
         }
+    analytics = getattr(instance, "analytics", None)
+    if analytics is not None:
+        snap = analytics.snapshot()
+        out["analytics"] = {
+            "totals": snap["totals"],
+            "occupancy": snap["occupancy"],
+            "tenants": snap["tenants"],
+            "topk": snap["topk"][:10],  # the full table lives at /topk
+        }
+    slo = getattr(instance, "slo", None)
+    if slo is not None:
+        out["slo"] = slo.snapshot()
     out["stages"] = instance.metrics.stage_snapshot()
     tracer = getattr(instance, "tracer", None)
     if tracer is not None:

@@ -305,8 +305,54 @@ class Metrics:
                      250, 500, 1000, 2500),
             registry=self.registry,
         )
+        # traffic analytics (ops/analytics.py device reduction +
+        # observability/analytics.py host merge): hot keys, per-tenant
+        # accounting, device-computed arena occupancy/churn
+        self.hot_key_hits = Counter(
+            "guber_tpu_hot_key_hits_total",
+            "Hits attributed to device-reported hot keys (top-K only; "
+            "unresolved slots render as s<shard>:slot<n>).",
+            ["key"],
+            registry=self.registry,
+        )
+        self.tenant_decisions = Counter(
+            "guber_tpu_tenant_decisions_total",
+            "Decisions per fairness tenant, by outcome "
+            "(under_limit | over_limit).",
+            ["tenant", "outcome"],
+            registry=self.registry,
+        )
+        self.arena_churn = Counter(
+            "guber_tpu_arena_churn_total",
+            "Bucket initializations seen by the drain reduction (slot "
+            "allocations + window resets — the arena's write churn).",
+            registry=self.registry,
+        )
+        self.arena_occupancy = Gauge(
+            "guber_tpu_arena_occupancy_slots",
+            "Device-computed arena slot occupancy from the last drain's "
+            "expiry plane, by state (live | expired).",
+            ["state"],
+            registry=self.registry,
+        )
+        # SLO burn-rate engine (observability/analytics.py SLOEngine)
+        self.slo_burn_rate = Gauge(
+            "guber_slo_burn_rate",
+            "Error-budget burn rate per objective and window "
+            "(1.0 = burning exactly the budget).",
+            ["slo", "window"],
+            registry=self.registry,
+        )
+        self.slo_firing = Gauge(
+            "guber_slo_firing",
+            "Multi-window burn-rate alert state per objective "
+            "(1 = firing).",
+            ["slo"],
+            registry=self.registry,
+        )
         self._stage_rings: Dict[str, _StageRing] = {}
         self._stage_rings_lock = threading.Lock()
+        self._slo_sink = None
 
     def add_scrape_hook(self, fn) -> None:
         """Register a callable run before every expose() — the analog of the
@@ -351,8 +397,49 @@ class Metrics:
 
         self.add_scrape_hook(refresh)
 
+    def watch_analytics(self, analytics=None, slo=None) -> None:
+        """Export the traffic-analytics occupancy gauges and the SLO
+        burn rates at scrape time, and route the shed funnel
+        (observe_shed) into the SLO engine's availability/shed-rate
+        objectives — sheds are QoS events but SLO evidence."""
+        if slo is not None:
+            self._slo_sink = slo
+
+        def refresh():
+            if analytics is not None:
+                occ = analytics.occupancy()
+                for state in ("live", "expired"):
+                    self.arena_occupancy.labels(state=state).set(occ[state])
+            if slo is not None:
+                for name, obj in slo.burn_rates().items():
+                    for win, burn in obj["windows"].items():
+                        self.slo_burn_rate.labels(
+                            slo=name, window=win).set(burn)
+                    self.slo_firing.labels(slo=name).set(
+                        1 if obj["firing"] else 0)
+
+        self.add_scrape_hook(refresh)
+
+    def observe_hot_key(self, key: str, hits: int) -> None:
+        if hits > 0:
+            self.hot_key_hits.labels(key=key).inc(hits)
+
+    def observe_tenant(self, tenant: str, under: int, over: int) -> None:
+        if under > 0:
+            self.tenant_decisions.labels(
+                tenant=tenant, outcome="under_limit").inc(under)
+        if over > 0:
+            self.tenant_decisions.labels(
+                tenant=tenant, outcome="over_limit").inc(over)
+
+    def observe_churn(self, inits: int) -> None:
+        if inits > 0:
+            self.arena_churn.inc(inits)
+
     def observe_shed(self, reason: str, n: int = 1) -> None:
         self.qos_shed.labels(reason=reason).inc(n)
+        if self._slo_sink is not None:
+            self._slo_sink.observe_shed(n)
 
     _BREAKER_STATES = {"closed": 0, "half_open": 1, "open": 2}
 

@@ -20,6 +20,15 @@ from typing import Callable, List, Optional, Sequence, TypeVar
 T = TypeVar("T")
 
 
+def tenant_of(req) -> str:
+    """Canonical tenant identity of one request: the rate-limit `name`
+    (the reference's metric/limit family; `unique_key` is the principal
+    WITHIN a tenant).  The fair-slotting call sites and the traffic
+    analytics' per-tenant accounting both key on THIS, so "tenant" means
+    the same thing in the scheduler and on the dashboard."""
+    return req.name or "default"
+
+
 def interleave_by_tenant(
     items: Sequence[T],
     tenant_of: Callable[[T], str],

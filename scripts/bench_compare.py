@@ -1,0 +1,177 @@
+"""Bench-regression gate: fresh CPU smoke vs the best prior round.
+
+`make bench-smoke` runs bench.py on the CPU backend (GUBER_BENCH_PLATFORM
+=cpu — same small shapes the tunnel-fallback smoke tiers use) and diffs
+the fresh throughput against the BEST prior BENCH_r*.json record in the
+repo root.  A regression past the noise floor (default 10%, CPU smoke
+numbers jitter) on either gated metric fails the build loudly:
+
+  * e2e_decisions_per_sec     the serving headline (client -> response)
+  * device_decisions_per_sec  the raw drain-window throughput
+
+Prior rounds are read defensively: rc != 0 or an empty `parsed` is
+skipped (r01/r02 are exactly that), and CPU numbers may live at the top
+level (explicit GUBER_BENCH_PLATFORM=cpu run) or nested under
+`cpu_smoke` (a tunnel-fallback record like r05) — both are understood.
+
+  python scripts/bench_compare.py                    # run + compare
+  python scripts/bench_compare.py --fresh-json F     # compare-only (tests)
+  python scripts/bench_compare.py --tolerance 0.2    # looser floor
+
+Exit codes: 0 ok / nothing to compare, 1 regression, 2 fresh run broken.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+
+GATED_METRICS = ("e2e_decisions_per_sec", "device_decisions_per_sec")
+
+
+def extract_cpu(parsed: dict | None) -> dict:
+    """The CPU-smoke tier of one bench record, wherever it lives."""
+    if not parsed:
+        return {}
+    nested = parsed.get("cpu_smoke")
+    if isinstance(nested, dict) and nested:
+        return nested
+    if parsed.get("backend") == "cpu":
+        return parsed
+    return {}
+
+
+def best_baseline(bench_dir: str) -> tuple[dict, list[str]]:
+    """Best-of per gated metric across all readable prior rounds (best-of,
+    not latest: the gate must catch a regression even when the previous
+    round already regressed)."""
+    best: dict = {}
+    used: list[str] = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if rec.get("rc") not in (0, None):
+            continue
+        cpu = extract_cpu(rec.get("parsed"))
+        took = False
+        for m in GATED_METRICS:
+            v = cpu.get(m)
+            if isinstance(v, (int, float)) and v > 0 and v > best.get(m, 0):
+                best[m] = float(v)
+                took = True
+        if took:
+            used.append(os.path.basename(path))
+    return best, used
+
+
+def run_fresh(budget_s: float) -> dict:
+    """One CPU smoke bench.py run; returns its single-line JSON result."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               GUBER_BENCH_PLATFORM="cpu",
+               GUBER_BENCH_BUDGET_S=str(budget_s))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        cwd=repo, env=env, capture_output=True, text=True,
+        timeout=budget_s + 120)
+    # bench.py guarantees ONE JSON line on stdout; scan from the end in
+    # case a library printed above it
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    raise RuntimeError(
+        f"bench.py produced no JSON (rc={proc.returncode}); stderr tail:\n"
+        + proc.stderr[-2000:])
+
+
+def compare(baseline: dict, fresh_cpu: dict, tolerance: float) -> list[str]:
+    """Regression lines past the noise floor (empty == gate passes)."""
+    failures = []
+    for m in GATED_METRICS:
+        base = baseline.get(m)
+        new = fresh_cpu.get(m)
+        if not base:
+            print(f"  {m}: no baseline — skipped")
+            continue
+        if not isinstance(new, (int, float)) or new <= 0:
+            failures.append(f"{m}: fresh run reported {new!r} "
+                            f"(baseline {base:,.0f})")
+            continue
+        ratio = new / base
+        verdict = "OK" if ratio >= 1.0 - tolerance else "REGRESSION"
+        print(f"  {m}: {new:,.0f} vs best {base:,.0f} "
+              f"({(ratio - 1.0) * 100.0:+.1f}%) {verdict}")
+        if verdict != "OK":
+            failures.append(
+                f"{m}: {new:,.0f} < {base:,.0f} * {1.0 - tolerance:.2f} "
+                f"({(ratio - 1.0) * 100.0:+.1f}%)")
+    return failures
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--bench-dir",
+                   default=os.path.dirname(
+                       os.path.dirname(os.path.abspath(__file__))),
+                   help="directory holding BENCH_r*.json (default: repo root)")
+    p.add_argument("--fresh-json", default="",
+                   help="compare-only: read the fresh result from this file "
+                   "instead of running bench.py")
+    p.add_argument("--tolerance", type=float,
+                   default=float(os.environ.get("GUBER_BENCH_TOLERANCE",
+                                                "0.10")),
+                   help="allowed fractional drop before failing "
+                   "(default 0.10)")
+    p.add_argument("--budget", type=float, default=480.0,
+                   help="wall budget (s) for the fresh bench.py run")
+    args = p.parse_args(argv)
+
+    baseline, used = best_baseline(args.bench_dir)
+    if not baseline:
+        print("bench gate: no usable BENCH_r*.json baseline — "
+              "nothing to compare, passing")
+        return 0
+    print(f"bench gate: baseline best-of {', '.join(used)}")
+
+    if args.fresh_json:
+        with open(args.fresh_json) as f:
+            fresh = json.load(f)
+    else:
+        try:
+            fresh = run_fresh(args.budget)
+        except Exception as e:  # noqa: BLE001 — broken run != regression
+            print(f"bench gate BROKEN: {e}", file=sys.stderr)
+            return 2
+    if fresh.get("error"):
+        print(f"bench gate BROKEN: fresh run error: {fresh['error']}",
+              file=sys.stderr)
+        return 2
+    fresh_cpu = extract_cpu(fresh)
+    if not fresh_cpu:
+        print("bench gate BROKEN: fresh result has no CPU tier "
+              f"(backend={fresh.get('backend')!r})", file=sys.stderr)
+        return 2
+
+    failures = compare(baseline, fresh_cpu, args.tolerance)
+    if failures:
+        print("bench gate FAILED:", file=sys.stderr)
+        for f_ in failures:
+            print(f"  {f_}", file=sys.stderr)
+        return 1
+    print("bench gate passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,473 @@
+"""Traffic-analytics suite: device stats reduction, hot-key top-K, SLO
+burn-rate alerting, and the analytics-off zero-overhead census.
+
+Four layers, matching the subsystem's structure:
+
+  * ops/analytics.py — the jitted per-drain reduction vs its numpy
+    oracle, bit-exact across rounds including the halving decay and the
+    native path's AGG_SLOT_BIT-tagged lanes;
+  * observability/analytics.py — the host rolling merge driven end-to-end
+    through a real Instance with a Zipf(1.1) keyset (precision@10 >= 0.9,
+    the acceptance bar scripts/probe_hotkey.py measures at scale), plus
+    the SLOEngine under a fake clock (deterministic firing);
+  * the serving-path census — the drain builders must be untouched by
+    analytics (same cached executable object before/after enabling) and
+    the enabled path may add exactly ONE device->host fetch per drain;
+  * the admin surface — /v1/admin/topk, the debug snapshot's analytics /
+    slo / engine-occupancy sections.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from functools import partial
+
+import jax
+import numpy as np
+import pytest
+
+import gubernator_tpu  # noqa: F401  (enables x64)
+from gubernator_tpu.api.types import Algorithm, RateLimitReq
+from gubernator_tpu.config import AnalyticsConfig, Config, EngineConfig, SLOConfig
+from gubernator_tpu.core.service import Instance
+from gubernator_tpu.observability.analytics import SLOEngine, TrafficAnalytics
+from gubernator_tpu.ops import analytics as ops
+from gubernator_tpu.ops.kernel import AGG_SLOT_BIT
+
+pytestmark = pytest.mark.analytics
+
+NOW = 1_700_000_000_000
+
+
+# ------------------------------------------------- device vs oracle (ops)
+
+def _synthetic_round(rng, C, B, K, T):
+    """One drain's worth of wire arrays, bit-packed like the real paths
+    (kernel.encode_batch_host request word0 + encode_output_word
+    response), with a random subset of lanes AGG-tagged like the native
+    router's compact lanes."""
+    packed = np.zeros((K, B, 2), np.int64)
+    words = np.zeros((K, B), np.int64)
+    tenants = rng.integers(0, T + 2, size=(K, B)).astype(np.int32)
+    for k in range(K):
+        n = int(rng.integers(1, B))
+        slot = np.full(B, -1, np.int64)
+        slot[:n] = rng.choice(C, size=n, replace=False)
+        hits = rng.integers(0, 50, B).astype(np.int64)
+        is_init = rng.integers(0, 2, B).astype(np.int64)
+        agg = rng.integers(0, 2, B).astype(np.int64)
+        w0 = ((slot + 1) | (agg * AGG_SLOT_BIT)
+              | (is_init << 32) | (hits << 34))
+        packed[k, :, 0] = np.where(slot < 0, 0, w0)
+        packed[k, :, 1] = rng.integers(1, 1 << 20, B)
+        # response word: random remaining (bits 0..30), the over-limit
+        # status at bit 31, random reset_enc above — the decode must
+        # read ONLY bit 31
+        words[k] = (rng.integers(0, 1 << 31, B)
+                    | (rng.integers(0, 2, B).astype(np.int64) << 31)
+                    | (rng.integers(0, 1 << 20, B).astype(np.int64) << 32))
+    return packed, words, tenants
+
+
+def test_shard_stats_matches_oracle_exactly():
+    """The jitted reduction and the numpy oracle agree bit-for-bit over
+    carried-sketch rounds, including a decay round."""
+    rng = np.random.default_rng(42)
+    C, B, K, T, topk, depth, width = 256, 64, 3, 8, 16, 4, 128
+    kw = dict(tenant_slots=T, topk=topk, over_weight=4)
+    jitted = jax.jit(partial(ops.shard_stats, **kw))
+
+    sk_dev = np.zeros((depth, width), np.int64)
+    sk_ora = sk_dev.copy()
+    expire = rng.choice(
+        [0, NOW - 5_000, NOW + 60_000], size=C,
+        p=[0.3, 0.2, 0.5]).astype(np.int64)
+    for rnd, decay in enumerate((0, 0, 1, 0)):
+        packed, words, tenants = _synthetic_round(rng, C, B, K, T)
+        sk_dev, st_dev = jitted(sk_dev, packed, words, tenants, expire,
+                                np.int64(NOW), np.int64(decay))
+        sk_dev, st_dev = np.asarray(sk_dev), np.asarray(st_dev)
+        sk_ora, st_ora = ops.oracle_stats(
+            sk_ora, packed, words, tenants, expire, NOW, decay, **kw)
+        assert np.array_equal(sk_dev, sk_ora), f"sketch diverged round {rnd}"
+        assert np.array_equal(st_dev, st_ora), f"stats diverged round {rnd}"
+        assert st_dev.shape == (ops.stats_len(T, topk),)
+
+
+def test_decode_strips_agg_bit():
+    """A native compact lane (slot+1 | AGG_SLOT_BIT) must attribute to
+    the real arena slot, not a clipped phantom."""
+    w0 = np.array([(7 + 1) | AGG_SLOT_BIT | (3 << 34), 0], np.int64)
+    packed = np.stack([w0, np.zeros_like(w0)], axis=-1)
+    d = ops._decode(np, packed, np.zeros(2, np.int64))
+    assert d.slot[0] == 7 and d.hits[0] == 3
+    assert d.slot[1] == -1 and d.occupied[1] == 0
+
+
+# ------------------------------------------------- instance end-to-end
+
+def _conf() -> Config:
+    return Config(engine=EngineConfig(
+        capacity_per_shard=4096, batch_per_shard=1024,
+        global_capacity=128, global_batch_per_shard=32,
+        max_global_updates=32))
+
+
+@pytest.fixture(scope="module")
+def inst_on():
+    conf = _conf()
+    conf.analytics.enabled = True
+    conf.slo.enabled = True
+    inst = Instance(conf)
+    inst.engine.warmup()
+    yield inst
+    inst.close()
+
+
+def _drive(inst, reqs):
+    return asyncio.run(inst.get_rate_limits(reqs))
+
+
+def test_topk_precision_zipf(inst_on):
+    """Acceptance bar: precision@10 >= 0.9 against the true heavy hitters
+    of a Zipf(1.1) trace (scripts/probe_hotkey.py runs the same check at
+    scale, open-loop)."""
+    rng = np.random.default_rng(11)
+    n_keys, decisions, batch = 600, 8000, 500
+    p = 1.0 / np.arange(1, n_keys + 1) ** 1.1
+    ranks = rng.choice(n_keys, size=decisions, p=p / p.sum())
+    for off in range(0, decisions, batch):
+        _drive(inst_on, [
+            RateLimitReq(name="zipf", unique_key=f"zk{r:04d}", hits=1,
+                         limit=1 << 20, duration=60_000,
+                         algorithm=Algorithm.TOKEN_BUCKET)
+            for r in ranks[off:off + batch]])
+    counts = np.bincount(ranks, minlength=n_keys)
+    true10 = {f"zipf_zk{r:04d}"
+              for r in np.argsort(-counts, kind="stable")[:10]}
+    got10 = {row["key"] for row in inst_on.analytics.topk_snapshot(10)}
+    precision = len(true10 & got10) / 10.0
+    assert precision >= 0.9, (
+        f"precision@10 {precision:.2f}: true {sorted(true10)} "
+        f"vs got {sorted(got10)}")
+
+
+def test_tenant_accounting_and_totals(inst_on):
+    """Per-tenant rows split by the fairness tenant (request name) with
+    correct under/over outcome counts."""
+    before = inst_on.analytics.snapshot()["tenants"]
+    for _ in range(4):  # limit=2 -> 2 under then 2 over per key
+        _drive(inst_on, [
+            RateLimitReq(name=f"acct{i}", unique_key="k", hits=1, limit=2,
+                         duration=60_000, algorithm=Algorithm.TOKEN_BUCKET)
+            for i in range(3)])
+    after = inst_on.analytics.snapshot()["tenants"]
+    for i in range(3):
+        name = f"acct{i}"
+        prev = before.get(name, {"decisions": 0, "over_limit": 0})
+        assert after[name]["decisions"] - prev["decisions"] == 4
+        assert after[name]["over_limit"] - prev["over_limit"] == 2
+    snap = inst_on.analytics.snapshot()
+    t = snap["totals"]
+    assert t["decisions"] == t["under_limit"] + t["over_limit"]
+    assert t["drains"] > 0 and t["inits"] > 0
+    assert snap["occupancy"]["live"] > 0
+
+
+def test_debug_snapshot_sections(inst_on):
+    """The one-read operator view: engine occupancy breakdown (the
+    cli `arena:` line's source), analytics and slo sections — and the
+    whole snapshot must survive json.dumps (it is served over HTTP)."""
+    from gubernator_tpu.observability import build_debug_snapshot
+    snap = build_debug_snapshot(inst_on)
+    eng = snap["engine"]
+    for k in ("live", "expired", "free", "capacity"):
+        assert k in eng, f"engine occupancy missing {k!r}"
+    assert eng["live"] + eng["expired"] + eng["free"] == eng["capacity"]
+    assert snap["analytics"]["totals"]["decisions"] > 0
+    assert len(snap["analytics"]["topk"]) <= 10
+    assert "drain_p99" in snap["slo"]["burn_rates"]
+    json.dumps(snap)
+
+
+def test_admin_topk_endpoint(inst_on):
+    """/v1/admin/topk serves the rolling table; ?n caps it; bad n is 400."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gubernator_tpu.api.http_gateway import build_app
+
+    async def body():
+        client = TestClient(TestServer(build_app(inst_on)))
+        await client.start_server()
+        try:
+            r = await client.get("/v1/admin/topk?n=3")
+            assert r.status == 200
+            snap = await r.json()
+            assert len(snap["topk"]) <= 3
+            assert snap["totals"]["decisions"] > 0
+            r = await client.get("/v1/admin/topk?n=bogus")
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    asyncio.run(body())
+
+
+def test_admin_topk_404_when_disabled():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gubernator_tpu.api.http_gateway import build_app
+
+    inst = Instance(_conf())
+    assert inst.analytics is None
+
+    async def body():
+        client = TestClient(TestServer(build_app(inst)))
+        await client.start_server()
+        try:
+            r = await client.get("/v1/admin/topk")
+            assert r.status == 404
+            assert "GUBER_ANALYTICS" in (await r.json())["error"]
+        finally:
+            await client.close()
+
+    try:
+        asyncio.run(body())
+    finally:
+        inst.close()
+
+
+def test_analytics_metric_families_observed(inst_on):
+    """The scrape carries real series: hot keys, tenant outcomes, churn,
+    device occupancy."""
+    text = inst_on.metrics.expose().decode()
+    g = inst_on.metrics.registry.get_sample_value
+    assert 'guber_tpu_hot_key_hits_total{key="' in text
+    assert g("guber_tpu_tenant_decisions_total",
+             {"tenant": "acct0", "outcome": "over_limit"}) >= 2.0
+    assert g("guber_tpu_arena_churn_total") > 0
+    assert g("guber_tpu_arena_occupancy_slots", {"state": "live"}) > 0
+
+
+# ------------------------------------------------- zero-overhead census
+
+def test_drain_builders_untouched_by_analytics():
+    """Analytics must compose AROUND the drain, not inside it: enabling
+    it returns the very same cached drain executables (so the off-path
+    jaxprs are byte-identical by construction), and no drain builder
+    grows an analytics parameter."""
+    import inspect
+
+    from gubernator_tpu.core import engine as engine_mod
+
+    inst = Instance(_conf())
+    try:
+        mesh = inst.engine.mesh
+        step_before = engine_mod._compiled_pipeline_step(mesh)
+        global_before = engine_mod._compiled_pipeline_step_global(mesh)
+        an = AnalyticsConfig()
+        an.enabled = True
+        inst.engine.enable_analytics(an)
+        assert engine_mod._compiled_pipeline_step(mesh) is step_before
+        assert engine_mod._compiled_pipeline_step_global(mesh) is global_before
+        for builder in (engine_mod._compiled_pipeline_step_impl,
+                        engine_mod._compiled_pipeline_step_global_impl):
+            params = inspect.signature(builder).parameters
+            assert not any("analytic" in p for p in params), (
+                f"{builder.__name__} grew an analytics parameter")
+    finally:
+        inst.close()
+
+
+def _count_drain_fetches(inst, reqs) -> int:
+    """Device->host fetches issued while serving one batch (one drain)."""
+    eng = inst.engine
+    n = {"fetches": 0}
+    orig_local, orig_stacked = eng._fetch_local, eng._fetch_local_stacked
+
+    def counted_local(arr):
+        n["fetches"] += 1
+        return orig_local(arr)
+
+    def counted_stacked(arr):
+        n["fetches"] += 1
+        return orig_stacked(arr)
+
+    eng._fetch_local = counted_local
+    eng._fetch_local_stacked = counted_stacked
+    try:
+        _drive(inst, reqs)
+    finally:
+        eng._fetch_local = orig_local
+        eng._fetch_local_stacked = orig_stacked
+    return n["fetches"]
+
+
+def test_transfer_census_one_extra_fetch_when_enabled():
+    """The analytics-off path issues exactly as many device->host fetches
+    as the seed (nothing new to fetch); the enabled path adds exactly ONE
+    (the stats vector riding the drain result's fetch stage)."""
+    def reqs(tag):
+        return [RateLimitReq(name="census", unique_key=f"{tag}{i}", hits=1,
+                             limit=100, duration=60_000,
+                             algorithm=Algorithm.TOKEN_BUCKET)
+                for i in range(64)]
+
+    counts = {}
+    for label, enabled in (("off", False), ("on", True)):
+        conf = _conf()
+        conf.analytics.enabled = enabled
+        inst = Instance(conf)
+        try:
+            inst.engine.warmup()
+            _drive(inst, reqs("warm"))  # compile + prime outside the count
+            counts[label] = _count_drain_fetches(inst, reqs("x"))
+            if enabled:
+                assert inst.analytics.snapshot()["totals"]["decisions"] > 0
+        finally:
+            inst.close()
+    assert counts["on"] == counts["off"] + 1, counts
+
+
+# ------------------------------------------------- SLO engine (fake clock)
+
+def _slo(windows="60:2", budget=0.01, now_fn=None) -> SLOEngine:
+    conf = SLOConfig()
+    conf.drain_p99_ms = 100.0
+    conf.drain_budget = budget
+    conf.shed_budget = budget
+    conf.availability = 0.999
+    conf.burn_windows = windows
+    return SLOEngine(conf, now_fn=now_fn)
+
+
+def test_slo_burn_fires_and_clears_deterministically():
+    """Fake-clock burn: slow drains push drain_p99 burn over threshold in
+    BOTH the window and its window/12 companion -> firing; a quiet
+    recovery period drains the windows -> clears."""
+    clock = {"t": 1000.0}
+    slo = _slo(windows="60:2", now_fn=lambda: clock["t"])
+    # 1 drain/s, half of them slow: bad fraction 0.5, burn 0.5/0.01 = 50
+    for i in range(60):
+        clock["t"] += 1.0
+        slo.observe_drain(0.2 if i % 2 else 0.01, decisions=10)
+    rates = slo.burn_rates()
+    assert rates["drain_p99"]["firing"] is True
+    assert rates["drain_p99"]["windows"]["60s"] == pytest.approx(50.0, rel=0.1)
+    assert rates["shed_rate"]["firing"] is False  # no sheds recorded
+    # recovery: 70s of fast drains pushes every slow sample out of window
+    for _ in range(70):
+        clock["t"] += 1.0
+        slo.observe_drain(0.01, decisions=10)
+    assert slo.burn_rates()["drain_p99"]["firing"] is False
+
+
+def test_slo_short_window_gates_stale_burn():
+    """Multi-window semantics: a burst that ended does NOT fire once the
+    short companion window (60/12 = 5s) is clean, even though the long
+    window still carries the burn."""
+    clock = {"t": 5000.0}
+    slo = _slo(windows="60:2", now_fn=lambda: clock["t"])
+    for _ in range(20):  # 20s of pure burn...
+        clock["t"] += 1.0
+        slo.observe_drain(0.5, decisions=10)
+    for _ in range(10):  # ...then 10s of recovery: long window still bad
+        clock["t"] += 1.0
+        slo.observe_drain(0.01, decisions=10)
+    rates = slo.burn_rates()["drain_p99"]
+    assert rates["windows"]["60s"] > 2.0  # long window still over threshold
+    assert rates["firing"] is False  # short companion is clean
+
+
+def test_slo_shed_and_error_feed_availability():
+    clock = {"t": 0.0}
+    slo = _slo(windows="30:1", now_fn=lambda: clock["t"])
+    for _ in range(10):
+        clock["t"] += 1.0
+        slo.observe_drain(0.01, decisions=90)
+        slo.observe_shed(10)  # 10% shed vs 1% budget -> burn 10
+    rates = slo.burn_rates()
+    assert rates["shed_rate"]["firing"] is True
+    assert rates["availability"]["firing"] is True
+    slo.observe_error(5)
+    assert slo.burn_rates()["availability"]["windows"]["30s"] > 0
+
+
+def test_slo_burn_rate_gauge_exported():
+    """guber_slo_burn_rate / guber_slo_firing carry the fake-clock burn
+    through a real scrape."""
+    from gubernator_tpu.observability.metrics import Metrics
+
+    clock = {"t": 100.0}
+    slo = _slo(windows="60:2", now_fn=lambda: clock["t"])
+    for _ in range(30):
+        clock["t"] += 1.0
+        slo.observe_drain(0.5, decisions=10)  # always slow: burn = 100
+    m = Metrics()
+    m.watch_analytics(slo=slo)
+    m.expose()
+    g = m.registry.get_sample_value
+    assert g("guber_slo_burn_rate",
+             {"slo": "drain_p99", "window": "60s"}) == pytest.approx(
+                 100.0, rel=0.1)
+    assert g("guber_slo_firing", {"slo": "drain_p99"}) == 1.0
+    # the shed funnel routes into the SLO engine via the metrics sink
+    m.observe_shed("queue_full", 3)
+    assert slo.burn_rates()["shed_rate"]["windows"]["60s"] > 0
+
+
+# ------------------------------------------------- host merge + config
+
+def test_rolling_table_decay_and_labels():
+    """Host-side halving tracks the device sketch cadence; unresolved
+    slots render as s<shard>:slot<n> until a label arrives."""
+    conf = AnalyticsConfig()
+    conf.topk = 4
+    clock = {"t": 0.0}
+    an = TrafficAnalytics(conf, now_fn=lambda: clock["t"])
+    V = ops.stats_len(conf.tenant_slots, conf.topk)
+    stats = np.zeros((1, V), np.int64)
+    base = ops.HEADER + conf.tenant_slots * ops.TENANT_COLS
+    stats[0, base:base + 4] = (9, 100, 10, 1)  # slot 9: est 100
+    an.ingest(stats)
+    row = an.topk_snapshot(1)[0]
+    assert row["key"] == "s0:slot9" and row["score"] == 100
+    an.label_slot(0, 9, "tenantA_hot")
+    assert an.topk_snapshot(1)[0]["key"] == "tenantA_hot"
+    # decayed ingest with no candidates halves the host score
+    an.ingest(np.zeros((1, V), np.int64), decayed=1)
+    assert an.topk_snapshot(1)[0]["score"] == 50
+    # decay cadence: first call primes, then fires after decay_ms
+    assert an.decay_flag(0.0) == 0
+    assert an.decay_flag(conf.decay_ms + 1.0) == 1
+    assert an.decay_flag(conf.decay_ms + 2.0) == 0
+
+
+def test_tenant_registry_overflow_to_other():
+    conf = AnalyticsConfig()
+    conf.tenant_slots = 4  # ids 1..3 nameable, rest share 0
+    an = TrafficAnalytics(conf)
+    ids = [an.tenant_id(f"t{i}") for i in range(6)]
+    assert ids[:3] == [1, 2, 3] and ids[3:] == [0, 0, 0]
+    assert an.tenant_id("t1") == 2  # stable on re-lookup
+
+
+def test_config_env_knobs(monkeypatch):
+    monkeypatch.setenv("GUBER_ANALYTICS", "1")
+    monkeypatch.setenv("GUBER_ANALYTICS_TOPK", "8")
+    monkeypatch.setenv("GUBER_ANALYTICS_SKETCH_DEPTH", "2")
+    c = AnalyticsConfig()
+    assert c.enabled and c.topk == 8 and c.sketch_depth == 2
+    c.validate()
+    monkeypatch.setenv("GUBER_ANALYTICS_SKETCH_DEPTH", "99")
+    with pytest.raises(ValueError):
+        AnalyticsConfig().validate()
+    monkeypatch.setenv("GUBER_SLO", "true")
+    monkeypatch.setenv("GUBER_SLO_BURN_WINDOWS", "60:2, 600:1,junk")
+    s = SLOConfig()
+    assert s.enabled
+    assert s.windows() == [(60.0, 2.0), (600.0, 1.0)]
+    monkeypatch.setenv("GUBER_SLO_BURN_WINDOWS", "garbage")
+    assert SLOConfig().windows() == [(300.0, 14.4), (1800.0, 6.0),
+                                     (7200.0, 1.0)]

@@ -30,6 +30,13 @@ NATIVE_NAMES = (
     "guber_tpu_windows_total",
     "guber_tpu_window_duration_seconds",
     "guber_tpu_stage_duration_ms",
+    # traffic analytics + SLO engine (observability/analytics.py)
+    "guber_tpu_hot_key_hits_total",
+    "guber_tpu_tenant_decisions_total",
+    "guber_tpu_arena_churn_total",
+    "guber_tpu_arena_occupancy_slots",
+    "guber_slo_burn_rate",
+    "guber_slo_firing",
 )
 
 
@@ -63,6 +70,59 @@ def test_reference_series_shapes():
     assert g("grpc_request_counts_total",
              {"status": "failed", **method}) == 1.0
     assert g("grpc_request_duration_milliseconds_count", method) == 2.0
+
+
+def test_every_metric_attribute_registered_exactly_once():
+    """Registry drift guard: every prometheus collector hanging off a
+    Metrics instance must live on THAT instance's registry (a collector
+    accidentally created against the process-global REGISTRY would leak
+    across instances and vanish from /metrics), and no two collectors may
+    claim the same family name."""
+    from prometheus_client.metrics import MetricWrapperBase
+
+    m = Metrics()
+    registered = m.registry._collector_to_names
+    collectors = {attr: v for attr, v in vars(m).items()
+                  if isinstance(v, MetricWrapperBase)}
+    assert collectors, "Metrics lost its collectors?"
+    for attr, coll in collectors.items():
+        assert coll in registered, (
+            f"Metrics.{attr} is not registered on the instance registry")
+    all_names = [n for names in registered.values() for n in names]
+    assert len(all_names) == len(set(all_names)), (
+        "duplicate family names in the registry")
+
+
+def test_no_orphaned_collectors():
+    """Dead-metric audit: every collector attribute must be OBSERVED
+    somewhere — referenced at least once outside its own `self.x = ...`
+    definition (in metrics.py's observe_*/watch_* helpers or any other
+    module).  A counter that is defined but never incremented is a
+    dashboard lie; wire it or delete it."""
+    import os
+    import re
+
+    from prometheus_client.metrics import MetricWrapperBase
+
+    m = Metrics()
+    attrs = [a for a, v in vars(m).items()
+             if isinstance(v, MetricWrapperBase)]
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "gubernator_tpu")
+    blob = []
+    for root, _dirs, files in os.walk(pkg):
+        for f in files:
+            if f.endswith(".py"):
+                with open(os.path.join(root, f), encoding="utf-8") as fh:
+                    blob.append(fh.read())
+    blob = "\n".join(blob)
+    orphans = []
+    for attr in attrs:
+        uses = len(re.findall(rf"\.{attr}\b", blob))
+        # one hit is the `self.{attr} = Counter(...)` definition itself
+        if uses < 2:
+            orphans.append(attr)
+    assert not orphans, f"collectors defined but never observed: {orphans}"
 
 
 def test_stage_labels_are_canonical():
