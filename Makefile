@@ -1,7 +1,7 @@
 # Dev targets (the reference Makefile:1-15 has only release/docker; we add
 # the working set).
 
-.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos test-obs test-chaos test-analytics proto bench bench-smoke docker lint cluster
+.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos test-obs test-chaos test-analytics test-overlap proto bench bench-smoke docker lint cluster
 
 test:
 	python -m pytest tests/ -x -q
@@ -52,6 +52,14 @@ test-chaos:
 test-analytics:
 	python -m pytest tests/ -x -q -m "analytics and not slow"
 
+# the overlapped-pipeline slice: depth-2/3 drains bit-identical to the
+# serial oracle (token+leaky, GLOBAL reconciliation, compact wire),
+# commit-queue ordering under injected dispatch faults and out-of-order
+# fetch completion, window-arena reuse accounting.  Part of tier-1
+# (`test-core` picks it up too); this target runs just the slice.
+test-overlap:
+	python -m pytest tests/ -x -q -m "overlap and not slow"
+
 proto:
 	cd gubernator_tpu/api/proto && protoc --python_out=. gubernator.proto peers.proto
 
@@ -60,9 +68,11 @@ bench:
 
 # bench-regression gate: fresh CPU smoke run of bench.py diffed against
 # the best prior BENCH_r*.json cpu numbers (10% noise floor); fails loudly
-# when e2e/device decisions-per-sec regress.
+# when e2e/device/host decisions-per-sec regress.  Then the open-loop
+# overlap probe prints the pipeline's stage split + realized overlap.
 bench-smoke:
 	python scripts/bench_compare.py
+	GUBER_PROBE_PLATFORM=cpu python scripts/probe_overlap.py
 
 docker:
 	docker build -t gubernator-tpu:latest .

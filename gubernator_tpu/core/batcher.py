@@ -20,11 +20,12 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence
 
-from gubernator_tpu.api.types import RateLimitReq, RateLimitResp
+from gubernator_tpu.api.types import Behavior, RateLimitReq, RateLimitResp
 from gubernator_tpu.config import BehaviorConfig
 from gubernator_tpu.core.engine import RateLimitEngine
 from gubernator_tpu.core.interval import ArmedInterval
 from gubernator_tpu.core.pipeline import DispatchPipeline
+from gubernator_tpu.core.window_buffers import RequestColumns
 from gubernator_tpu.net.faults import FAULTS, SEAM_ENGINE_DISPATCH
 from gubernator_tpu.qos import interleave_by_tenant, shed_response
 from gubernator_tpu.qos.fairness import tenant_of
@@ -58,6 +59,17 @@ class WindowBatcher:
         # None keeps every legacy code path byte-identical.
         self.qos = qos
         self._pending: List[tuple] = []  # (req, accumulate, future)
+        # Columnar mirror of _pending (classic batched lane, non-lockstep
+        # only): submit-time accumulation so _flush can hand engine.process
+        # zero-copy column slices instead of re-walking the request objects
+        # on the engine thread.  Valid only while the mirror exactly matches
+        # _pending row-for-row (no GLOBAL entries); any deviation — GLOBAL
+        # submit, tenant-fair permutation, cwnd split leftover — drops the
+        # columns for that window and resynchronizes.
+        self._cols: Optional[RequestColumns] = (
+            None if lockstep_clock is not None or engine.native is None
+            else RequestColumns())
+        self._cols_valid = True
         self._interval: Optional[ArmedInterval] = None
         self._waiter: Optional[asyncio.Task] = None
         # one thread == one device stream; serializes all engine access
@@ -371,6 +383,13 @@ class WindowBatcher:
             return await self.pipeline.submit_one(req)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending.append((req, accumulate, fut))
+        if self._cols is not None:
+            if req.behavior == Behavior.GLOBAL:
+                # GLOBAL rides the listed lane inside process(); the
+                # columnar fast path covers regular keys only
+                self._cols_valid = False
+            else:
+                self._cols.append(req)
         if self.clock is not None:
             return await fut  # the tick loop drains on the cluster cadence
         if len(self._pending) >= self._window_limit():
@@ -391,25 +410,39 @@ class WindowBatcher:
     def _flush(self) -> None:
         window = self._pending
         self._pending = []
+        use_cols = self._cols is not None and self._cols_valid
         if self.qos is not None:
             if self.qos.fair_slotting:
                 window = interleave_by_tenant(window, lambda t: tenant_of(t[0]))
+                use_cols = False  # permuted: rows no longer match _cols
             # the congestion window caps decisions-per-dispatch: the excess
             # stays queued for the next cycle (and re-arms the timer so it
             # cannot strand if no further submit arrives)
             limit = self._window_limit()
             if len(window) > limit:
                 window, self._pending = window[:limit], window[limit:]
+                use_cols = False  # leftovers desync the columnar mirror
                 if self._interval is None:
                     self._interval = ArmedInterval(self.behaviors.batch_wait)
                 self._interval.arm()
                 if self._waiter is None or self._waiter.done():
                     self._waiter = asyncio.create_task(self._wait_interval())
-        asyncio.create_task(self._run_window(window))
+        cols = None
+        if self._cols is not None:
+            if use_cols and self._cols.n == len(window):
+                # detach: the window task reads these arrays while new
+                # submits accumulate into a fresh mirror
+                cols, self._cols = self._cols, RequestColumns()
+            else:
+                self._cols.reset()
+            self._cols_valid = True
+        asyncio.create_task(self._run_window(window, cols))
 
-    async def _run_window(self, window: List[tuple]) -> None:
+    async def _run_window(self, window: List[tuple],
+                          cols: Optional[RequestColumns] = None) -> None:
         reqs = [w[0] for w in window]
         accumulate = [w[1] for w in window]
+        columns = cols.take(None, 0, cols.n) if cols is not None else None
         loop = asyncio.get_running_loop()
         start = time.monotonic()
         def run():
@@ -421,7 +454,8 @@ class WindowBatcher:
                 prof.before_drain()
             try:
                 now = self.now_fn() if self.now_fn is not None else None
-                return self.engine.process(reqs, now, accumulate)
+                return self.engine.process(reqs, now, accumulate,
+                                           columns=columns)
             finally:
                 if profiling:
                     prof.after_drain()

@@ -65,11 +65,17 @@ from gubernator_tpu.state.arena import SlotTable
 def _k_buckets_from_env():
     from gubernator_tpu.config import env_int
     kmax = env_int("GUBER_PIPELINE_KMAX", 8)
-    # sparse above 8: every bucket is one warmup compile (tens of seconds
-    # over a tunneled chip), so the extended ladder trades shape fit for
-    # boot time
-    base = [1, 2, 4, 8, 32, 128, 512]
-    return tuple(b for b in base if b < kmax) + (kmax,)
+    # dense through 8, sparse above: dispatch cost is linear in the PADDED
+    # bucket, so a k=3 drain padded to kb=4 wastes a third of its device
+    # time — and k in [1, 8] is exactly where the overlapped pipeline's
+    # occupancy gate lands under steady load.  Above 8 every bucket is one
+    # warmup compile (tens of seconds over a tunneled chip), so the
+    # extended ladder keeps trading shape fit for boot time.
+    buckets = list(range(1, min(kmax, 8) + 1))
+    buckets += [b for b in (32, 128, 512) if buckets[-1] < b < kmax]
+    if kmax > buckets[-1]:
+        buckets.append(kmax)
+    return tuple(buckets)
 
 
 PIPELINE_K_BUCKETS = _k_buckets_from_env()
@@ -622,20 +628,30 @@ class RateLimitEngine:
         lanes: List[Optional[tuple]] = [None] * len(requests)
 
         if reg_idx:
-            keys_b = [requests[i].hash_key().encode("utf-8") for i in reg_idx]
+            # single pass over the window: one walk fills the key blob and
+            # all four numeric columns (the old per-field list
+            # comprehensions re-touched every request object five times)
+            n = len(reg_idx)
+            keys_b = []
+            rhits, rlim, rdur, ralgo = [], [], [], []
+            for i in reg_idx:
+                r = requests[i]
+                keys_b.append(r.hash_key().encode("utf-8"))
+                rhits.append(r.hits)
+                rlim.append(r.limit)
+                rdur.append(r.duration)
+                ralgo.append(r.algorithm)
             key_bytes = np.frombuffer(b"".join(keys_b), dtype=np.uint8)
             key_ends = np.cumsum([len(k) for k in keys_b]).astype(np.int64)
-            n = len(reg_idx)
             out_shard = np.empty(n, np.int32)
             out_lane = np.empty(n, np.int32)
             shard_fill = np.zeros(self.num_local_shards, np.int32)
             packed = self.native.pack_window(
                 key_bytes, key_ends,
-                np.asarray([requests[i].hits for i in reg_idx], np.int64),
-                np.asarray([requests[i].limit for i in reg_idx], np.int64),
-                np.asarray([requests[i].duration for i in reg_idx], np.int64),
-                np.asarray([requests[i].algorithm for i in reg_idx],
-                           np.int32),
+                np.asarray(rhits, np.int64),
+                np.asarray(rlim, np.int64),
+                np.asarray(rdur, np.int64),
+                np.asarray(ralgo, np.int32),
                 now, B,
                 view.slot, view.hits, view.limit, view.duration, view.algo,
                 view.is_init.view(np.uint8),
@@ -673,6 +689,7 @@ class RateLimitEngine:
         now: Optional[int] = None,
         accumulate: Optional[Sequence[bool]] = None,
         upserts: Optional[Sequence] = None,
+        columns: Optional[tuple] = None,
     ) -> List[RateLimitResp]:
         """Window processing with the C++ router resolving regular keys.
 
@@ -709,32 +726,43 @@ class RateLimitEngine:
                 if err is not None:
                     raise ValueError(err)
 
-        # split into regular (columnar) and global (listed) requests
-        reg_idx: List[int] = []
-        keys_b: List[bytes] = []
-        rhits: List[int] = []
-        rlim: List[int] = []
-        rdur: List[int] = []
-        ralgo: List[int] = []
+        # split into regular (columnar) and global (listed) requests —
+        # unless the caller already accumulated the window columnarly
+        # (RequestColumns), in which case the split is known to be trivial
+        # (no GLOBAL lanes) and the columns arrive as zero-copy slices
         glob: List[tuple] = []
-        for i, r in enumerate(requests):
-            if r.behavior == Behavior.GLOBAL:
-                glob.append((i, r, accumulate is None or accumulate[i]))
-            else:
-                reg_idx.append(i)
-                keys_b.append(r.hash_key().encode("utf-8"))
-                rhits.append(r.hits)
-                rlim.append(r.limit)
-                rdur.append(r.duration)
-                ralgo.append(r.algorithm)
-        nreg = len(reg_idx)
+        if columns is not None:
+            key_bytes, key_ends, c_hits, c_lim, c_dur, c_algo = columns
+            nreg = len(key_ends)
+            if nreg != len(requests):
+                raise ValueError("prebuilt columns must cover every request")
+            reg_idx: Sequence[int] = range(nreg)
+        else:
+            reg_idx = []
+            keys_b: List[bytes] = []
+            rhits: List[int] = []
+            rlim: List[int] = []
+            rdur: List[int] = []
+            ralgo: List[int] = []
+            for i, r in enumerate(requests):
+                if r.behavior == Behavior.GLOBAL:
+                    glob.append((i, r, accumulate is None or accumulate[i]))
+                else:
+                    reg_idx.append(i)
+                    keys_b.append(r.hash_key().encode("utf-8"))
+                    rhits.append(r.hits)
+                    rlim.append(r.limit)
+                    rdur.append(r.duration)
+                    ralgo.append(r.algorithm)
+            nreg = len(reg_idx)
+            if nreg:
+                key_bytes = np.frombuffer(b"".join(keys_b), dtype=np.uint8)
+                key_ends = np.cumsum([len(k) for k in keys_b]).astype(np.int64)
+                c_hits = np.asarray(rhits, dtype=np.int64)
+                c_lim = np.asarray(rlim, dtype=np.int64)
+                c_dur = np.asarray(rdur, dtype=np.int64)
+                c_algo = np.asarray(ralgo, dtype=np.int32)
         if nreg:
-            key_bytes = np.frombuffer(b"".join(keys_b), dtype=np.uint8)
-            key_ends = np.cumsum([len(k) for k in keys_b]).astype(np.int64)
-            c_hits = np.asarray(rhits, dtype=np.int64)
-            c_lim = np.asarray(rlim, dtype=np.int64)
-            c_dur = np.asarray(rdur, dtype=np.int64)
-            c_algo = np.asarray(ralgo, dtype=np.int32)
             out_shard = np.zeros(nreg, np.int32)
             out_lane = np.zeros(nreg, np.int32)
         shard_fill = np.zeros(self.num_local_shards, np.int32)
@@ -1220,6 +1248,17 @@ class RateLimitEngine:
                         key=lambda s: s.index[1].start or 0)
         return np.concatenate([np.asarray(s.data) for s in shards], axis=1)
 
+    def fetch_stacked_many(self, arrs):
+        """Fetch several stacked outputs of ONE drain in a single
+        device_get.  The pipeline's fetch stage previously issued one
+        blocking device_get per plane (words, then mismatch flag, then
+        stats) — each is a separate host sync point on the transfer stream;
+        batching them into one call lets the runtime coalesce the copies
+        (core/pipeline.py `_complete_sync`)."""
+        if not self.multiprocess:
+            return jax.device_get(list(arrs))
+        return [self._fetch_local_stacked(a) for a in arrs]
+
     def _lane_bucket(self, max_fill: int) -> int:
         """Occupied-prefix lane width: the smallest compiled lane-bucket
         >= max_fill.  Slicing the staged window to the occupied prefix makes
@@ -1325,6 +1364,10 @@ class RateLimitEngine:
         self.windows_processed += 1
         return kernel.split_outputs(self._fetch_local(fused), lanes)
 
+    # per-engine cache of the compiled stacked-drain executable (the mesh
+    # never changes after construction)
+    _pipeline_fn = None
+
     def pipeline_dispatch(self, packed, nows, n_windows: Optional[int] = None):
         """Dispatch a stacked compact drain (core/pipeline.py) WITHOUT
         fetching: K serving windows in one device call, regular keys only
@@ -1350,7 +1393,12 @@ class RateLimitEngine:
         if self.multiprocess:
             packed = self._sharded_in_stacked(np.ascontiguousarray(packed))
             nows = self._repl_in(np.asarray(nows, np.int64))
-        fn = _compiled_pipeline_step(self.mesh)
+        # cache the compiled step on the engine: the lru_cache lookup in
+        # _compiled_pipeline_step hashes the mesh on EVERY drain, which is
+        # measurable at sub-ms dispatch cadence
+        fn = self._pipeline_fn
+        if fn is None:
+            fn = self._pipeline_fn = _compiled_pipeline_step(self.mesh)
         with jax.profiler.StepTraceAnnotation(
                 "guber_drain", step_num=self.windows_processed):
             self.state, words, limits, mism = fn(self.state, packed, nows)
@@ -1452,10 +1500,18 @@ class RateLimitEngine:
         requests: Sequence[RateLimitReq],
         now: Optional[int] = None,
         accumulate: Optional[Sequence[bool]] = None,
+        columns: Optional[tuple] = None,
     ) -> List[RateLimitResp]:
-        """step() with automatic chunking when a window overflows the caps."""
+        """step() with automatic chunking when a window overflows the caps.
+
+        `columns` is an optional prebuilt (key_bytes, key_ends, hits, limit,
+        duration, algo) tuple covering ALL of `requests` (native path only,
+        no GLOBAL requests) — callers that accumulate submissions in
+        RequestColumns (core/window_buffers.py) hand over array slices
+        instead of having this method re-walk the request objects."""
         if self.native is not None:
-            return self._process_native(requests, now, accumulate)
+            return self._process_native(requests, now, accumulate,
+                                        columns=columns)
         S = self.num_shards
         SL = self.num_local_shards
         if self.multiprocess:

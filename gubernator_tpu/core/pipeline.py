@@ -27,6 +27,29 @@ maximizes the numerator and hides the denominator:
      over caller buffers), so completing out of order is safe; per-key
      ordering was committed at dispatch on the engine thread.
 
+The OVERLAPPED drain pipeline (GUBER_PIPELINE_DEPTH, default 3) runs these
+stages double/triple-buffered: while drain N's device execution is in
+flight, the engine thread is already host-encoding drain N+1 and a fetch
+worker is decoding drain N-1.  Commits still flow through ONE ordered
+completion queue — every _on_completed runs on the event loop, and all
+device work serializes on the single-thread engine executor — so results
+are bit-identical to a serial (depth-1) pipeline regardless of completion
+order (tests/test_pipeline_overlap.py proves this differentially).  Host
+staging comes from a ring of preallocated arenas (core/window_buffers.py)
+instead of fresh numpy allocations: an arena is reused only after its
+drain's fetch completed (device provably done reading the H2D buffers),
+and error paths drop the arena rather than risk recycling one a transfer
+may still be reading.  Single-request submits accumulate into columnar
+arrays at submit time (RequestColumns), so window packing takes zero-copy
+column slices instead of walking request objects.
+
+The pump is occupancy-gated (GUBER_PIPELINE_GATE): with a drain already in
+flight, a new drain dispatches only once the estimated staged lanes would
+fill ~one window (GUBER_PIPELINE_GATE_FRAC of B·S).  On a host whose
+dispatch cost is fill-independent this maximizes decisions-per-dispatch
+without adding latency — an outstanding completion always re-pumps, and
+the gate disarms at in_flight == 0, so it can never deadlock.
+
 Reference analog: a peer draining its queue ships batches back-to-back
 without waiting for each response (peers.go:143-172); the reference's
 500µs/1000-item aggregation window (config.go:60-62) corresponds to the
@@ -48,6 +71,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Optional, Sequence
@@ -61,8 +85,10 @@ from gubernator_tpu.api.types import (
     RateLimitResp,
     millisecond_now,
 )
-from gubernator_tpu.config import MAX_BATCH_SIZE
+from gubernator_tpu.config import MAX_BATCH_SIZE, env_bool, env_float, env_int
 from gubernator_tpu.core.engine import PIPELINE_K_BUCKETS
+from gubernator_tpu.core.window_buffers import RequestColumns, WindowArenaRing
+from gubernator_tpu.net.faults import FAULTS, SEAM_ENGINE_DISPATCH
 from gubernator_tpu.observability.tracing import current_context
 from gubernator_tpu.ops import kernel
 from gubernator_tpu.qos import interleave_by_tenant
@@ -198,15 +224,19 @@ class RpcJob:
         self.forward_task = None
 
     def finish(self, pipeline, wflat, clflat, now):
+        # the encode target is a per-fetch-thread scratch buffer: bytes()
+        # copies out before this thread touches another job, so reuse is
+        # safe and the hot path allocates nothing proportional to n
         if not len(self.remote_idx):
-            resp_buf = np.empty(self.n * 64 + 64, np.uint8)
+            resp_buf = pipeline._resp_buf(self.n * 64 + 64)
             m = pipeline.engine.native.fastpath_encode_w(
                 wflat, self.limit, now, wflat.shape[-1], self.n,
                 self.row, self.lane, self.pos, resp_buf, climit=clflat)
             return bytes(resp_buf[:m])
         # mixed RPC: encode the LOCAL items as framed per-item segments;
-        # forwarded slots splice in later (_assemble_mixed)
-        seg_buf = np.empty(self.n * 64 + 64, np.uint8)
+        # forwarded slots splice in later (_assemble_mixed).  item_off/
+        # item_len escape into the async splice, so they stay per-job.
+        seg_buf = pipeline._resp_buf(self.n * 64 + 64)
         item_off = np.empty(self.n, np.int64)
         item_len = np.empty(self.n, np.int32)
         pipeline.engine.native.fastpath_encode_parts(
@@ -324,13 +354,19 @@ class _DrainResult:
                  "leftover", "now", "n_decisions", "n_lanes", "k_used",
                  "error", "started", "ring_peers",
                  "pack_done", "dispatch_done", "fetch_start", "fetch_done",
-                 "oldest_enq")
+                 "oldest_enq", "arena", "cols_owner", "cfut")
 
     def __init__(self):
         self.words = None
         self.limits = None
         self.mism = None
         self.gfused = None
+        # staging ownership: the drain's arena (returned to the ring only
+        # on clean completion), the RequestColumns its singles sliced from,
+        # and the early-submitted fetch future (engine-thread hop cut)
+        self.arena = None
+        self.cols_owner = None
+        self.cfut = None
         # traffic analytics (ops/analytics.py): the un-fetched device stats
         # array, its host copy, and whether this drain's reduction decayed
         self.stats = None
@@ -369,7 +405,7 @@ class DispatchPipeline:
 
     def __init__(self, engine, engine_executor: ThreadPoolExecutor,
                  metrics=None, k_max: int = PIPELINE_K_BUCKETS[-1],
-                 depth: int = 3, lockstep: Optional[bool] = None,
+                 depth: Optional[int] = None, lockstep: Optional[bool] = None,
                  qos=None, tracer=None, profile=None, analytics=None,
                  slo=None):
         self.engine = engine
@@ -406,7 +442,26 @@ class DispatchPipeline:
         self.metrics = metrics
         self._engine_executor = engine_executor
         self.k_max = k_max
-        self.depth = depth
+        # pipeline depth = maximum concurrently in-flight drains (host
+        # encodes N+1 while the device executes N and a fetch worker
+        # decodes N-1).  Depth 1 degenerates to the serial oracle the
+        # differential suite compares against.
+        self.depth = env_int("GUBER_PIPELINE_DEPTH", 3) if depth is None \
+            else depth
+        # occupancy gate (see module docstring): with a drain in flight,
+        # hold the next dispatch until ~gate_frac of one window's lanes
+        # are pending.  Dispatch cost is fill-independent (the executable
+        # shape is fixed per bucket), so fuller windows are strictly more
+        # decisions per unit of engine-thread time.
+        self.gate_enabled = env_bool("GUBER_PIPELINE_GATE", True)
+        self.gate_frac = env_float("GUBER_PIPELINE_GATE_FRAC", 1.0)
+        # DEBUG ONLY: block until the device finishes each dispatch so the
+        # stage stamps attribute wall time exactly (host-encode vs device
+        # vs fetch).  This is a deliberate host sync point — it serializes
+        # the pipeline and must never be on in production (the audit of
+        # _drain_sync_inner found no unconditional syncs; this flag is the
+        # one opt-in exception).
+        self.sync_debug = env_bool("GUBER_PIPELINE_SYNC_DEBUG", False)
         # injectable clock (tests pin it for differential comparisons)
         self.now_fn: Callable[[], int] = millisecond_now
         # gate for the raw-RPC lane: requires a standalone instance or a
@@ -436,11 +491,25 @@ class DispatchPipeline:
         # — per-key ordering was already committed at dispatch.
         # GUBER_FETCH_WORKERS tunes the pool once the transfer-overlap
         # factor is re-measured on real hardware.
-        from gubernator_tpu.config import env_int
         self._fetch_executor = ThreadPoolExecutor(
             max_workers=env_int("GUBER_FETCH_WORKERS", 2),
             thread_name_prefix="guber-fetch")
-        self._singles: List[tuple] = []   # (req, fut)
+        # staging arenas (ring of reusable buffers) + columnar singles
+        # accumulation — see core/window_buffers.py and module docstring
+        self._arena_ring = WindowArenaRing(metrics=metrics)
+        self._cols = RequestColumns()
+        self._cols_pool: List[RequestColumns] = []
+        # per-fetch-thread response encode buffer (RpcJob.finish)
+        self._tls = threading.local()
+        # overlap accounting: cumulative per-stage busy seconds and the
+        # wall time the pipeline spent non-idle (in_flight > 0).  The
+        # overlap ratio Σbusy/active_wall is 1.0 for a perfectly serial
+        # pipeline and approaches the stage count under full overlap.
+        self.stage_busy = {"host_encode": 0.0, "device_dispatch": 0.0,
+                           "fetch_decode": 0.0}
+        self.active_wall = 0.0
+        self._active_since = 0.0
+        self._singles: List[tuple] = []   # (req, fut, t_enq, ctx, col_idx)
         # GLOBAL singles (lockstep mode only): staged into the tick drain's
         # composed GLOBAL window, never mixed into regular ListJobs
         self._gsingles: List[tuple] = []  # (req, fut)
@@ -483,6 +552,48 @@ class DispatchPipeline:
         t = self._loop.create_task(coro)
         self._tasks.add(t)
         t.add_done_callback(self._tasks.discard)
+
+    def _resp_buf(self, size: int) -> np.ndarray:
+        """This fetch thread's reusable proto-encode buffer (grown to
+        fit; callers bytes()-copy out before returning)."""
+        buf = getattr(self._tls, "buf", None)
+        if buf is None or buf.nbytes < size:
+            buf = self._tls.buf = np.empty(
+                max(size, MAX_BATCH_SIZE * 64 + 64), np.uint8)
+        return buf
+
+    def _note_inflight(self, delta: int) -> None:
+        """All in-flight transitions route through here (event loop only):
+        keeps the gauge, the QoS admission view, and the pipeline-active
+        wall clock (overlap denominator) consistent."""
+        self._in_flight += delta
+        now = time.monotonic()
+        if delta > 0 and self._in_flight == 1:
+            self._active_since = now
+        elif delta < 0 and self._in_flight == 0 and self._active_since:
+            self.active_wall += now - self._active_since
+            self._active_since = 0.0
+        if self.metrics is not None:
+            self.metrics.pipeline_inflight_windows.set(self._in_flight)
+        if self.qos is not None:
+            self.qos.admission.note_inflight(self._in_flight)
+
+    def overlap_snapshot(self) -> dict:
+        """Point-in-time overlap statistics (admin introspection + the
+        open-loop probe, scripts/probe_overlap.py): per-stage busy
+        seconds, pipeline-active wall seconds, and their ratio."""
+        wall = self.active_wall
+        if self._active_since:
+            wall += time.monotonic() - self._active_since
+        busy = sum(self.stage_busy.values())
+        return {
+            "stage_busy_seconds": dict(self.stage_busy),
+            "active_wall_seconds": wall,
+            "overlap_ratio": (busy / wall) if wall > 0 else 0.0,
+            "inflight_windows": self._in_flight,
+            "arena_reuse_events": self._arena_ring.reuse_events,
+            "arena_alloc_events": self._arena_ring.alloc_events,
+        }
 
     def install_ring(self, points, peer_of, peers, self_idx) -> None:
         """Install the cluster ring (engine thread): the C parser's point
@@ -528,7 +639,11 @@ class DispatchPipeline:
             # GLOBAL lanes spread round-robin instead)
             self._gsingles.append((req, fut))
         else:
-            self._singles.append((req, fut, t_enq, ctx))
+            # columnar accumulation at submit time: the drain takes window
+            # columns as slices of self._cols instead of re-walking
+            # request objects (core/window_buffers.py)
+            self._singles.append((req, fut, t_enq, ctx,
+                                  self._cols.append(req)))
         self._pump()
         return await fut
 
@@ -586,10 +701,19 @@ class DispatchPipeline:
 
     # ------------------------------------------------------------ pump
 
-    def _take_jobs(self) -> List[object]:
+    def _take_jobs(self) -> tuple:
+        """Snapshot pending work into drain jobs (loop thread).  Returns
+        (jobs, cols_owner): cols_owner is the detached RequestColumns the
+        singles chunks slice from — it belongs to THIS drain until its
+        completion releases it back to the pool (ListJob.finish still
+        reads the limit column on the fetch thread)."""
         jobs: List[object] = []
+        cols_owner = None
         if self._singles:
             singles, self._singles = self._singles, []
+            cols_owner = self._cols
+            self._cols = (self._cols_pool.pop() if self._cols_pool
+                          else RequestColumns())
             if self.qos is not None:
                 if self.qos.fair_slotting:
                     # tenant-fair lane filling: a hot tenant's burst must
@@ -602,17 +726,44 @@ class DispatchPipeline:
                 # callbacks re-pump with force=True)
                 budget = self.qos.congestion.effective_window()
                 if len(singles) > budget:
-                    singles, self._singles = (singles[:budget],
-                                              singles[budget:])
+                    singles, deferred = (singles[:budget],
+                                         singles[budget:])
+                    # the deferred tail re-accumulates into the NEW
+                    # columns (its old indices die with cols_owner)
+                    self._singles = [
+                        (req, fut, t_enq, ctx, self._cols.append(req))
+                        for req, fut, t_enq, ctx, _ in deferred]
             for base in range(0, len(singles), MAX_BATCH_SIZE):
                 chunk = singles[base:base + MAX_BATCH_SIZE]
-                jobs.append(ListJob([t[0] for t in chunk],
-                                    futs=[t[1] for t in chunk],
-                                    ctxs=[t[3] for t in chunk],
-                                    enq=min(t[2] for t in chunk)))
+                job = ListJob([t[0] for t in chunk],
+                              futs=[t[1] for t in chunk],
+                              ctxs=[t[3] for t in chunk],
+                              enq=min(t[2] for t in chunk))
+                # zero-copy when the chunk is contiguous in submission
+                # order (the common no-QoS case); a tenant-fair or
+                # budget-cut permutation gathers instead
+                idx = np.fromiter((t[4] for t in chunk), np.int64,
+                                  len(chunk))
+                if len(idx) == 1 or bool((np.diff(idx) == 1).all()):
+                    job._cols = cols_owner.take(None, int(idx[0]),
+                                                int(idx[-1]) + 1)
+                else:
+                    job._cols = cols_owner.take(idx, 0, len(idx))
+                jobs.append(job)
         jobs.extend(self._jobs)
         self._jobs = []
-        return jobs
+        return jobs, cols_owner
+
+    def _cols_release(self, cols) -> None:
+        """Return a drain's RequestColumns to the pool (loop thread, at
+        completion).  Unlike arenas there is no transfer-safety concern —
+        the device never reads these buffers (pack copies into the arena
+        synchronously) — so error paths release too."""
+        if cols is None:
+            return
+        cols.reset()
+        if len(self._cols_pool) < 4:
+            self._cols_pool.append(cols)
 
     def _pump(self, force: bool = False) -> None:
         if self.lockstep:
@@ -621,6 +772,23 @@ class DispatchPipeline:
                  else self.qos.congestion.effective_depth(self.depth))
         if self._closed or self._in_flight >= depth:
             return
+        if self.gate_enabled and self._in_flight >= 1 and self.gate_frac > 0:
+            # occupancy gate: a drain is already hiding the device time, so
+            # hold the next dispatch until the pending work would fill
+            # ~gate_frac of one window's lanes.  Estimate lanes from queued
+            # decisions via the live duplicate-fold factor.  No timer
+            # needed: the in-flight drain's completion re-pumps, and at
+            # in_flight == 0 the gate is off — it can never strand work.
+            fold = (self.decisions_staged / self.lanes_staged
+                    if self.lanes_staged > MAX_BATCH_SIZE else 1.0)
+            pending = (len(self._singles)
+                       + sum(len(j.data) // 16 if isinstance(j, RpcJob)
+                             else j.n for j in self._jobs))
+            lanes_est = pending / max(fold, 1.0)
+            eng = self.engine
+            if lanes_est < (self.gate_frac * eng.batch_per_shard
+                            * eng.num_local_shards):
+                return
         if not force and self.coalesce_wait > 0:
             # RpcJobs are unparsed here: estimate items from the wire size
             # (>= ~16B/item, so this overestimates — big RPCs never wait)
@@ -635,12 +803,14 @@ class DispatchPipeline:
         if self._coalesce_handle is not None:
             self._coalesce_handle.cancel()
             self._coalesce_handle = None
-        jobs = self._take_jobs()
+        jobs, cols = self._take_jobs()
         if not jobs:
+            self._cols_release(cols)
             return
-        self._in_flight += 1
+        self._note_inflight(1)
         fut = self._loop.run_in_executor(self._engine_executor,
-                                         self._drain_sync, jobs)
+                                         self._drain_sync, jobs, None, None,
+                                         None, cols)
         fut.add_done_callback(lambda f: self._on_dispatched(f, jobs))
 
     def _coalesce_fire(self) -> None:
@@ -689,14 +859,14 @@ class DispatchPipeline:
         assert self.lockstep
         if self._loop is None:
             self._loop = asyncio.get_running_loop()
-        jobs = self._take_jobs() if not self._closed else []
+        jobs, cols = self._take_jobs() if not self._closed else ([], None)
         gjob = self._take_global_job() if not self._closed else None
         all_jobs = jobs + ([gjob] if gjob is not None else [])
-        self._in_flight += 1
+        self._note_inflight(1)
         fut = self._loop.run_in_executor(
             self._engine_executor,
             lambda: self._drain_sync(jobs, now=now, k_fixed=k_stack,
-                                     gjob=gjob))
+                                     gjob=gjob, cols=cols))
         fut.add_done_callback(lambda f: self._on_dispatched(f, all_jobs))
         return fut
 
@@ -705,7 +875,7 @@ class DispatchPipeline:
             res: _DrainResult = fut.result()
         except Exception as e:  # drain itself crashed (bug): fail ITS jobs
             log.exception("pipeline drain failed")
-            self._in_flight -= 1
+            self._note_inflight(-1)
             for job in jobs:
                 self._resolve_error(job, e)
             self._pump(force=True)
@@ -713,17 +883,34 @@ class DispatchPipeline:
         # fallback jobs re-route outside the pipeline
         for job in res.fallback:
             self._route_fallback(job)
-        # leftover jobs did not fit this stack: front of the queue
+        # leftover jobs did not fit this stack: front of the queue.  A
+        # leftover singles chunk borrows column views from THIS drain's
+        # cols_owner, which is released at completion — materialize copies
+        # so the repack (a later drain) never reads recycled buffers.
         if res.leftover:
+            for job in res.leftover:
+                cols = getattr(job, "_cols", None)
+                if cols is not None:
+                    job._cols = cols[:2] + tuple(np.array(c)
+                                                 for c in cols[2:])
             self._jobs[:0] = res.leftover
         if res.error is not None:
-            self._in_flight -= 1
+            self._note_inflight(-1)
+            self._cols_release(res.cols_owner)
             for job in res.staged:
                 self._resolve_error(job, res.error)
             self._pump(force=True)
             return
         if not res.staged:
-            self._in_flight -= 1
+            self._note_inflight(-1)
+            self._cols_release(res.cols_owner)
+            if not self.lockstep:
+                # nothing staged ⇒ nothing dispatched against the arena:
+                # safe to recycle immediately.  (A lockstep idle tick DOES
+                # dispatch its all-zero stack — there the arena is simply
+                # dropped, matching the old fresh-allocation cost.)
+                self._arena_ring.release(res.arena)
+            res.arena = None
             self._pump(force=True)
             return
         # start forwards for cluster-mode mixed RPCs NOW, so the peer round
@@ -735,9 +922,18 @@ class DispatchPipeline:
                  if isinstance(j, RpcJob) and len(j.remote_idx)]
         if mixed:
             self._spawn_forwards(mixed, res.ring_peers)
-        cfut = self._loop.run_in_executor(self._fetch_executor,
-                                          self._complete_sync, res)
-        cfut.add_done_callback(lambda f: self._on_completed(f, res))
+        if res.cfut is not None:
+            # fetch was already submitted from the engine thread at the end
+            # of the drain (hop cut: no event-loop round trip between
+            # dispatch and fetch).  Completion still lands on the loop —
+            # the single ordered completion queue — via call_soon_threadsafe.
+            res.cfut.add_done_callback(
+                lambda f: self._loop.call_soon_threadsafe(
+                    self._on_completed, f, res))
+        else:
+            cfut = self._loop.run_in_executor(self._fetch_executor,
+                                              self._complete_sync, res)
+            cfut.add_done_callback(lambda f: self._on_completed(f, res))
         # a second drain may dispatch while this one's fetch is in flight
         self._pump(force=True)
 
@@ -813,17 +1009,28 @@ class DispatchPipeline:
                     one_chunk(owner_idx, items[base:base + MAX_BATCH_SIZE]))
 
     def _on_completed(self, fut, res: _DrainResult) -> None:
-        self._in_flight -= 1
+        self._note_inflight(-1)
+        self._cols_release(res.cols_owner)
+        res.cols_owner = None
         try:
             _, outs = fut.result()
         except Exception as e:  # fetch/demux failed: fail THIS drain's jobs
             log.exception("pipeline fetch failed")
+            # the arena is NOT released: a failed fetch gives no proof the
+            # device finished reading its buffers, so the ring self-heals
+            # by allocating a replacement later
+            res.arena = None
             if self.slo is not None:  # availability evidence: errored work
                 self.slo.observe_error(max(1, res.n_decisions))
             for job in res.staged:
                 self._resolve_error(job, e)
             self._pump(force=True)
             return
+        # CLEAN completion: the fetch materialized the drain's outputs, so
+        # the device provably consumed the staged stack — the arena may be
+        # recycled for a future drain
+        self._arena_ring.release(res.arena)
+        res.arena = None
         for job, out in zip(res.staged, outs):
             if isinstance(job, RpcJob):
                 self.rpc_served += 1
@@ -843,9 +1050,31 @@ class DispatchPipeline:
         # EWMA and the guber_tpu_stage_duration_ms histograms read the
         # same number for the same drain
         drain_wall = (res.fetch_done or time.monotonic()) - res.started
+        # per-stage busy seconds: the overlap numerator, and the AIMD's
+        # stage-boundary observe points (when pipelined, the cycle estimate
+        # is the BOTTLENECK stage, not the stage sum — overlapped stages
+        # hide behind the slowest one)
+        t_he = res.pack_done - res.started if res.pack_done else 0.0
+        t_disp = (res.dispatch_done - res.pack_done
+                  if res.dispatch_done and res.pack_done else 0.0)
+        t_fetch = (res.fetch_done - res.fetch_start
+                   if res.fetch_done and res.fetch_start else 0.0)
+        sb = self.stage_busy
+        sb["host_encode"] += t_he
+        sb["device_dispatch"] += t_disp
+        sb["fetch_decode"] += t_fetch
+        if self.metrics is not None:
+            wall = self.active_wall
+            if self._active_since:
+                wall += time.monotonic() - self._active_since
+            if wall > 0:
+                self.metrics.pipeline_overlap_ratio.set(
+                    sum(sb.values()) / wall)
         if self.qos is not None and res.n_decisions:
             self.qos.congestion.observe_drain(
                 drain_wall, depth=max(1, res.k_used))
+            self.qos.congestion.observe_stages(t_he, t_disp, t_fetch,
+                                               pipelined=self.depth > 1)
         # traffic analytics + SLO evidence, from the same completion clock
         # the AIMD and stage histograms read
         if self.analytics is not None and res.stats_host is not None:
@@ -973,7 +1202,8 @@ class DispatchPipeline:
 
     def _drain_sync(self, jobs: List[object], now: Optional[int] = None,
                     k_fixed: Optional[int] = None,
-                    gjob: Optional[_GlobalJob] = None) -> _DrainResult:
+                    gjob: Optional[_GlobalJob] = None,
+                    cols: Optional[RequestColumns] = None) -> _DrainResult:
         """Engine-thread drain entry: wraps the real drain in the armed
         jax.profiler capture when POST /v1/admin/profile requested one
         (plain int read when disarmed — the hot path pays nothing)."""
@@ -982,20 +1212,34 @@ class DispatchPipeline:
             prof.before_drain()
             try:
                 return self._drain_sync_inner(jobs, now=now,
-                                              k_fixed=k_fixed, gjob=gjob)
+                                              k_fixed=k_fixed, gjob=gjob,
+                                              cols=cols)
             finally:
                 prof.after_drain()
         return self._drain_sync_inner(jobs, now=now, k_fixed=k_fixed,
-                                      gjob=gjob)
+                                      gjob=gjob, cols=cols)
 
     def _drain_sync_inner(self, jobs: List[object],
                           now: Optional[int] = None,
                           k_fixed: Optional[int] = None,
-                          gjob: Optional[_GlobalJob] = None) -> _DrainResult:
+                          gjob: Optional[_GlobalJob] = None,
+                          cols: Optional[RequestColumns] = None
+                          ) -> _DrainResult:
         """Pack every job into one stacked compact dispatch (engine thread).
 
-        Fresh numpy staging per drain: the previous drain's arrays may still
-        be feeding an in-flight host→device transfer.
+        Staging comes from the arena ring (core/window_buffers.py): the
+        previous drain's arrays may still be feeding an in-flight
+        host→device transfer, so a drain's arena is recycled only after ITS
+        OWN fetch completed — never while this drain could overwrite it.
+
+        Host sync audit: this path contains NO unconditional blocking
+        device reads.  copy_to_host_async() starts the D2H copies without
+        waiting; the only blocking fetches live in _complete_sync (on the
+        fetch pool, off this thread); GUBER_PIPELINE_SYNC_DEBUG opts into
+        one deliberate block-until-ready per dispatch for exact stage
+        attribution.  The legacy step path's _dispatch does fetch
+        synchronously on this thread — that is the fallback lane, not the
+        drain.
 
         Lockstep mode (k_fixed set): `now` is the tick's cluster-agreed
         timestamp and the dispatch shape is ALWAYS [k_fixed] — issued even
@@ -1014,13 +1258,20 @@ class DispatchPipeline:
         if now is None:
             now = self.now_fn()
         res.now = now
+        res.cols_owner = cols
         rpc_ok = self.rpc_enabled and eng._compact_enabled
         list_ok = (eng._compact_sound if self.lockstep
                    else eng._compact_enabled)
 
-        packed = np.zeros((K, S, B, 2), np.int64)
-        fills = np.zeros((K, S), np.int32)
-        kcur = np.zeros(S, np.int32)
+        arena = self._arena_ring.acquire(K, S, B)
+        res.arena = arena
+        arena.dirty = True
+        # the arena may be deeper than K (ring matches K >=); trailing
+        # rows stay zero, and the k-stride is K-independent, so the C
+        # calls and the [:kb] dispatch slices below are unaffected
+        packed = arena.packed
+        fills = arena.fills
+        kcur = arena.kcur
         native.drain_begin()
         stack_empty = True
         res.ring_peers = self._ring_peers
@@ -1029,20 +1280,21 @@ class DispatchPipeline:
                 if not rpc_ok:
                     res.fallback.append(job)
                     continue
-                job.row = np.empty(MAX_BATCH_SIZE, np.int32)
-                job.lane = np.empty(MAX_BATCH_SIZE, np.int32)
-                job.pos = np.empty(MAX_BATCH_SIZE, np.int32)
-                job.limit = np.empty(MAX_BATCH_SIZE, np.int64)
-                job.off = np.empty(MAX_BATCH_SIZE, np.int64)
-                job.mlen = np.empty(MAX_BATCH_SIZE, np.int32)
-                n = native.fastpath_parse_stack(
-                    job.data, now, B, K, MAX_BATCH_SIZE, packed, kcur,
-                    fills, job.row, job.lane, job.pos, job.limit, job.off,
-                    job.mlen, use_ring=not job.peer_mode)
+                scr = arena.acquire_scratch()
+                job.row, job.lane, job.pos = scr.row, scr.lane, scr.pos
+                job.limit, job.off, job.mlen = scr.limit, scr.off, scr.mlen
+                n = native.parse_stack_fast(
+                    job.data, now, B, K, MAX_BATCH_SIZE, arena, scr,
+                    use_ring=not job.peer_mode)
                 if n >= 0:
                     job.n = n
                     job.remote_idx = np.flatnonzero(job.row[:n] < -1)
                     res.staged.append(job)
+                    if len(job.remote_idx):
+                        # the forward coroutines keep reading off/mlen on
+                        # the loop after this drain completes: the block
+                        # leaves the pool with the job (recycle drops it)
+                        scr.leased = True
                     if len(job.remote_idx) < n:
                         stack_empty = False
                 elif n == -6 and not stack_empty:
@@ -1054,12 +1306,20 @@ class DispatchPipeline:
                 if not list_ok:
                     res.fallback.append(job)
                     continue
-                cols = job.columns()
-                job.row = np.empty(job.n, np.int32)
-                job.lane = np.empty(job.n, np.int32)
-                job.pos = np.empty(job.n, np.int32)
-                rc = native.pack_stack(*cols, now, B, K, packed, kcur,
-                                       fills, job.row, job.lane, job.pos)
+                jcols = job.columns()
+                if job.n > MAX_BATCH_SIZE:
+                    # oversized submit_many batch: the C router rejects it
+                    # (-3) before writing, but the scratch block could not
+                    # hold its demux anyway — route it to the legacy lane
+                    res.fallback.append(job)
+                    continue
+                scr = arena.acquire_scratch()
+                # slice to job.n: finish()'s fancy-indexed demux must see
+                # exactly n entries (the views share the cached C pointers)
+                job.row = scr.row[:job.n]
+                job.lane = scr.lane[:job.n]
+                job.pos = scr.pos[:job.n]
+                rc = native.pack_stack_fast(*jcols, now, B, K, arena, scr)
                 if rc >= 0:
                     res.staged.append(job)
                     stack_empty = False
@@ -1128,8 +1388,8 @@ class DispatchPipeline:
             dispatched = False
             try:
                 words, limits, mism, gfused = eng.pipeline_dispatch_global(
-                    packed, np.full(K, now, np.int64), gbatch, gacc, upd,
-                    n_windows=k_used)
+                    packed[:K], np.full(K, now, np.int64), gbatch, gacc,
+                    upd, n_windows=k_used)
                 dispatched = True  # sentinel: windows_processed advances
                 # by k_used, which is 0 on an idle tick — the counter
                 # alone cannot distinguish 'dispatched 0 windows' from
@@ -1147,7 +1407,7 @@ class DispatchPipeline:
                 # rejoin the lockstep — raise so the batcher fail-stops
                 # instead of silently desyncing.
                 if not dispatched and eng.windows_processed == before:
-                    zeros = np.zeros_like(packed)
+                    zeros = np.zeros_like(packed[:K])
                     zb, za, zu = eng.empty_drain_control()
                     for attempt in range(3):
                         try:
@@ -1181,6 +1441,12 @@ class DispatchPipeline:
         elif k_used:  # an all-forwarded drain has nothing to dispatch
             kb = next(b for b in self._k_buckets if b >= k_used)
             try:
+                # fault seam: an injected dispatch failure aborts the C
+                # router's staged allocations (no partial commit) and fails
+                # exactly this drain's jobs — neighbors in flight commit
+                # through the ordered completion queue untouched
+                if FAULTS.enabled:
+                    FAULTS.on_sync(SEAM_ENGINE_DISPATCH, "pipeline")
                 words, limits, mism = eng.pipeline_dispatch(
                     packed[:kb], np.full(kb, now, np.int64),
                     n_windows=k_used)
@@ -1200,6 +1466,11 @@ class DispatchPipeline:
                 self._analytics_dispatch(res, packed, words, now)
         else:
             native.commit()  # nothing staged: empty by construction
+        if self.sync_debug and res.words is not None:
+            # DEBUG host sync (see __init__): make dispatch_done include
+            # device execution so the stage stamps are exact
+            import jax
+            jax.block_until_ready(res.words)
         res.dispatch_done = time.monotonic()
         # forwarded items are the OWNER's decisions, not ours — counting
         # them here would double-count cluster-wide (the owner's peer-lane
@@ -1215,6 +1486,15 @@ class DispatchPipeline:
         res.n_lanes = int(fills.sum())
         self.decisions_staged += res.n_decisions
         self.lanes_staged += res.n_lanes
+        # hop cut: submit the fetch from HERE (engine thread) instead of
+        # bouncing through the event loop first — the fetch worker starts
+        # the blocking device read one loop-latency earlier.  Mixed RPCs
+        # keep the loop hop: their forward tasks must exist (spawned in
+        # _on_dispatched) before completion can demux them.
+        if res.staged and not any(isinstance(j, RpcJob)
+                                  and len(j.remote_idx)
+                                  for j in res.staged):
+            res.cfut = self._fetch_executor.submit(self._complete_sync, res)
         return res
 
     def _analytics_dispatch(self, res: _DrainResult, packed, words,
@@ -1278,12 +1558,17 @@ class DispatchPipeline:
             wflat = np.empty((0, B), np.int64)
             clflat = None
         else:
-            # _fetch_local_stacked: this process's shard blocks of the
-            # global [K, S, ...] arrays (plain device_get single-process);
-            # rows then index as k * S_local + shard, exactly how the C
-            # router staged them
-            words = np.ascontiguousarray(eng._fetch_local_stacked(res.words))
-            mism = eng._fetch_local_stacked(res.mism)
+            # ONE device_get for the response words AND the mismatch flags
+            # (engine.fetch_stacked_many): each separate blocking fetch is
+            # its own host sync point on the transfer stream, and the
+            # mism plane is tiny — fetching it separately doubled the
+            # fixed round-trip cost of every drain.  The limits plane
+            # stays conditional: it is only read when a stored-limit
+            # mismatch actually fired (rare), so the common path never
+            # moves it.  Rows index as k * S_local + shard, exactly how
+            # the C router staged them.
+            words, mism = eng.fetch_stacked_many([res.words, res.mism])
+            words = np.ascontiguousarray(words)
             clflat = None
             if mism.any():
                 clflat = np.ascontiguousarray(

@@ -258,6 +258,42 @@ class NativeRouter:
             _ptr(out_mlen, ctypes.c_int32),
         )
 
+    def parse_stack_fast(self, data: bytes, now: int, lanes: int,
+                         K: int, max_items: int, arena, scr,
+                         use_ring: bool = True) -> int:
+        """fastpath_parse_stack against a WindowArena + JobScratch
+        (core/window_buffers.py): identical semantics, but every output
+        pointer was derived once at buffer allocation instead of per call
+        — the per-call ctypes pointer derivation is a measured fixed cost
+        on the drain's host-encode stage."""
+        buf = ctypes.cast(ctypes.c_char_p(data),
+                          ctypes.POINTER(ctypes.c_uint8))
+        return self._lib.fastpath_parse_stack(
+            self._handle, buf, len(data), now, lanes, K, max_items,
+            1 if use_ring else 0,
+            arena.p_packed, arena.p_kcur, arena.p_fills,
+            scr.p_row, scr.p_lane, scr.p_pos,
+            scr.p_limit, scr.p_off, scr.p_mlen,
+        )
+
+    def pack_stack_fast(self, key_bytes: np.ndarray, key_ends: np.ndarray,
+                        hits: np.ndarray, limits: np.ndarray,
+                        durations: np.ndarray, algos: np.ndarray, now: int,
+                        lanes: int, K: int, arena, scr) -> int:
+        """router_pack_stack against a WindowArena + JobScratch (cached
+        stack/demux pointers; the per-chunk request columns still derive
+        theirs per call — they are fresh slices each drain)."""
+        return self._lib.router_pack_stack(
+            self._handle,
+            _ptr(key_bytes, ctypes.c_uint8), _ptr(key_ends, ctypes.c_int64),
+            len(key_ends),
+            _ptr(hits, ctypes.c_int64), _ptr(limits, ctypes.c_int64),
+            _ptr(durations, ctypes.c_int64), _ptr(algos, ctypes.c_int32),
+            now, lanes, K,
+            arena.p_packed, arena.p_kcur, arena.p_fills,
+            scr.p_row, scr.p_lane, scr.p_pos,
+        )
+
     def fastpath_encode_parts(self, w0: np.ndarray, item_limit: np.ndarray,
                               now: int, lanes: int, n: int,
                               out_row: np.ndarray, out_lane: np.ndarray,

@@ -130,6 +130,7 @@ def build_debug_snapshot(instance) -> dict:
             "pending_peak": adm.pending_peak,
             "max_pending": adm.max_pending,
             "saturated": adm.saturated,
+            "inflight_windows": adm.inflight_windows,
             "shed_counts": dict(adm.shed_counts),
         }
         out["congestion"] = {
@@ -139,6 +140,8 @@ def build_debug_snapshot(instance) -> dict:
             "congested": cong.congested,
             "increases": cong.increases,
             "decreases": cong.decreases,
+            "stage_ewma_ms": {k: v * 1000.0
+                              for k, v in cong.stage_ewma.items()},
         }
     out["peers"] = [
         {"host": p.host, "is_owner": p.is_owner,
@@ -168,6 +171,8 @@ def build_debug_snapshot(instance) -> dict:
             "lanes_staged": pipe.lanes_staged,
             "fused_serving": pipe.fused_serving,
             "lockstep": pipe.lockstep,
+            "depth": pipe.depth,
+            "overlap": pipe.overlap_snapshot(),
         }
     analytics = getattr(instance, "analytics", None)
     if analytics is not None:

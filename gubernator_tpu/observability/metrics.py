@@ -169,6 +169,28 @@ class Metrics:
             buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
             registry=self.registry,
         )
+        # overlapped drain pipeline (core/pipeline.py): concurrent drains in
+        # flight, the host/device/fetch overlap achieved, and staging arena
+        # recycling (core/window_buffers.py) — overlap_ratio is
+        # sum(stage busy) / pipeline-active wall, so 1.0 means strictly
+        # serial stages and ~depth means perfect overlap
+        self.pipeline_inflight_windows = Gauge(
+            "guber_tpu_pipeline_inflight_windows",
+            "Drain windows currently in flight between dispatch and commit.",
+            registry=self.registry,
+        )
+        self.pipeline_overlap_ratio = Gauge(
+            "guber_tpu_pipeline_overlap_ratio",
+            "Aggregate stage busy time divided by pipeline-active wall time "
+            "(1.0 = serial, >1 = host/device/fetch stages overlapped).",
+            registry=self.registry,
+        )
+        self.window_buffer_reuse = Counter(
+            "guber_tpu_window_buffer_reuse_total",
+            "Drain staging arena acquisitions by outcome.",
+            ["event"],  # reuse | alloc
+            registry=self.registry,
+        )
         # state lifecycle (state/snapshot.py, state/migrate.py): the slot
         # occupancy gauges come from engine.cache_stats at scrape time
         self.cache_slots = Gauge(

@@ -518,9 +518,18 @@ def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
     slot_clean = jnp.where(agg, batch.slot & jnp.int32(~AGG_SLOT_BIT),
                            batch.slot)
     # Sort by slot (stable → arrival order preserved within a slot); pads last.
+    # Packed single-key sort instead of jnp.argsort: fold (key, lane) into one
+    # i64 word with the lane index in the low bits.  A single-array sort of
+    # that word is bit-identical to a stable argsort (ties break on lane
+    # order) but avoids XLA's variadic comparator sort, which costs ~5x more
+    # per window on the CPU backend (BENCH_NOTES round 6).
     sort_key = jnp.where(valid, slot_clean, jnp.int32(2**31 - 1))
-    order = jnp.argsort(sort_key)
-    s_slot = sort_key[order]
+    lane_bits = max((B - 1).bit_length(), 1)
+    packed_key = ((sort_key.astype(I64) << lane_bits)
+                  | lax.iota(I64, B))
+    sorted_key = lax.sort(packed_key, is_stable=False)
+    order = (sorted_key & jnp.int64((1 << lane_bits) - 1)).astype(I32)
+    s_slot = (sorted_key >> lane_bits).astype(I32)
     s_valid = valid[order]
     # Permute the request fields as ONE packed [B, 6] row gather instead of
     # six separate gathers: gather/scatter launches are a measured fixed

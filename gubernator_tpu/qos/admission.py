@@ -56,6 +56,10 @@ class AdmissionController:
         self.now_fn = now_fn
         self.pending = 0
         self.pending_peak = 0
+        # Windows currently in flight through the overlapped drain
+        # pipeline (host-encoded or dispatched, not yet committed) —
+        # updated by core/pipeline.py at every in-flight transition.
+        self.inflight_windows = 0
         self.shed_counts: dict = {}
         # Set during graceful departure (daemon.py stop()): new work is
         # shed in-band with reason `draining` while already-admitted
@@ -87,13 +91,20 @@ class AdmissionController:
         if self.pending < 0:  # defensive: never let accounting go negative
             self.pending = 0
 
+    def note_inflight(self, windows: int) -> None:
+        """Pipeline depth signal: how many drain windows are currently in
+        flight.  Folded into the wait estimate — work ahead of a new
+        request includes windows already encoded/dispatched, not just the
+        pending queue."""
+        self.inflight_windows = max(0, int(windows))
+
     # ----------------------------------------------------------- estimates
 
     def estimate_wait(self) -> float:
         """Queue-theoretic wait bound: cycles to drain what's ahead plus
         the request's own drain, at the congestion EWMA cycle time."""
         cw = max(self.congestion.effective_window(), 1)
-        cycles = self.pending / cw + 1.0
+        cycles = self.pending / cw + 1.0 + self.inflight_windows
         return cycles * self.congestion.drain_cycle_estimate()
 
     @property

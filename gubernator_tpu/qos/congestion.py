@@ -43,6 +43,14 @@ class CongestionController:
         # telemetry for tests/metrics
         self.decreases = 0
         self.increases = 0
+        # stage-boundary EWMAs (overlapped pipeline): host encode, device
+        # dispatch, fetch+decode — fed per drain by the pipeline's
+        # completion path.  When drains overlap, the cycle cadence is the
+        # BOTTLENECK stage, not the stage sum.
+        self.stage_ewma = {"host_encode": 0.0, "device_dispatch": 0.0,
+                           "fetch_decode": 0.0}
+        self._stages_observed = False
+        self._pipelined = False
 
     # ------------------------------------------------------------- signal
 
@@ -77,6 +85,24 @@ class CongestionController:
                                  self._cwnd + self.increase)
                 self.increases += 1
 
+    def observe_stages(self, host: float, device: float, fetch: float,
+                       pipelined: bool = True) -> None:
+        """Feed one drain's stage-boundary decomposition: host encode
+        (columnar pack), device dispatch (enqueue through device done) and
+        fetch+decode.  With overlap enabled the steady-state cadence is
+        bounded by max(stage), not the sum — drain_cycle_estimate()
+        switches to that bound once stage data exists."""
+        a = self.alpha
+        obs = {"host_encode": host, "device_dispatch": device,
+               "fetch_decode": fetch}
+        if not self._stages_observed:
+            self.stage_ewma.update(obs)
+            self._stages_observed = True
+        else:
+            for k, v in obs.items():
+                self.stage_ewma[k] += a * (v - self.stage_ewma[k])
+        self._pipelined = bool(pipelined)
+
     # ------------------------------------------------------------- policy
 
     def effective_window(self) -> int:
@@ -99,6 +125,10 @@ class CongestionController:
         fresh node must not promise instant service to a 1ms deadline."""
         if not self._observed:
             return self.target_latency
+        if self._pipelined and self._stages_observed:
+            # Overlapped drains: cycles complete at the bottleneck stage's
+            # cadence (BASELINE.md cost model — bound is max, not sum).
+            return max(max(self.stage_ewma.values()), 1e-6)
         return max(self.latency_ewma, 1e-6)
 
     @property

@@ -8,6 +8,8 @@ numbers jitter) on either gated metric fails the build loudly:
 
   * e2e_decisions_per_sec     the serving headline (client -> response)
   * device_decisions_per_sec  the raw drain-window throughput
+  * host_decisions_per_sec    the pipelined host path (RPC bytes -> C
+                              parse -> stacked dispatch -> C encode)
 
 Prior rounds are read defensively: rc != 0 or an empty `parsed` is
 skipped (r01/r02 are exactly that), and CPU numbers may live at the top
@@ -30,7 +32,8 @@ import os
 import subprocess
 import sys
 
-GATED_METRICS = ("e2e_decisions_per_sec", "device_decisions_per_sec")
+GATED_METRICS = ("e2e_decisions_per_sec", "device_decisions_per_sec",
+                 "host_decisions_per_sec")
 
 
 def extract_cpu(parsed: dict | None) -> dict:

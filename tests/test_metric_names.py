@@ -37,6 +37,10 @@ NATIVE_NAMES = (
     "guber_tpu_arena_occupancy_slots",
     "guber_slo_burn_rate",
     "guber_slo_firing",
+    # overlapped drain pipeline (core/pipeline.py, core/window_buffers.py)
+    "guber_tpu_pipeline_inflight_windows",
+    "guber_tpu_pipeline_overlap_ratio",
+    "guber_tpu_window_buffer_reuse_total",
 )
 
 
