@@ -1,7 +1,7 @@
 # Dev targets (the reference Makefile:1-15 has only release/docker; we add
 # the working set).
 
-.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos test-obs test-chaos test-analytics test-overlap proto bench bench-smoke docker lint cluster
+.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos test-obs test-chaos test-analytics test-overlap test-frontdoor proto bench bench-smoke docker lint cluster
 
 test:
 	python -m pytest tests/ -x -q
@@ -60,6 +60,13 @@ test-analytics:
 test-overlap:
 	python -m pytest tests/ -x -q -m "overlap and not slow"
 
+# the multi-process front-door slice: worker-sharded serving differential
+# vs the single-process oracle (columnar + raw lanes, GLOBAL, forwarding),
+# in-band sheds (draining / ring_full), worker crash-restart with no
+# partial commit.  Part of tier-1 (`test-core` picks it up too).
+test-frontdoor:
+	python -m pytest tests/ -x -q -m "frontdoor and not slow"
+
 proto:
 	cd gubernator_tpu/api/proto && protoc --python_out=. gubernator.proto peers.proto
 
@@ -69,10 +76,13 @@ bench:
 # bench-regression gate: fresh CPU smoke run of bench.py diffed against
 # the best prior BENCH_r*.json cpu numbers (10% noise floor); fails loudly
 # when e2e/device/host decisions-per-sec regress.  Then the open-loop
-# overlap probe prints the pipeline's stage split + realized overlap.
+# overlap probe prints the pipeline's stage split + realized overlap, and
+# a short front-door sweep (in-process baseline vs 2 acceptor workers)
+# reports e2e decisions/s + shm ring stall % through the worker path.
 bench-smoke:
 	python scripts/bench_compare.py
 	GUBER_PROBE_PLATFORM=cpu python scripts/probe_overlap.py
+	GUBER_PROBE_PLATFORM=cpu GUBER_PROBE_FD_WORKERS=0,2 GUBER_PROBE_SECONDS=2 python scripts/probe_frontdoor.py
 
 docker:
 	docker build -t gubernator-tpu:latest .

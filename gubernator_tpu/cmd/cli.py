@@ -191,6 +191,19 @@ def cmd_debug(args) -> int:
               f"queued={hints.get('queued_total', {})} "
               f"replayed={hints.get('replayed_total', {})} "
               f"expired={hints.get('expired_total', {})}")
+    fd = snap.get("frontdoor")
+    if fd:
+        print(f"frontdoor: workers={fd['workers']} "
+              f"mode={fd.get('port_mode')} address={fd.get('address')} "
+              f"restarts={fd.get('restarts', 0)} "
+              f"records_served={fd.get('records_served', 0)}")
+        for i, row in enumerate(fd.get("per_worker", [])):
+            print(f"  worker {i}: pid={row.get('pid')} "
+                  f"port={row.get('port')} epoch={row.get('epoch')} "
+                  f"restarts={row.get('restarts')} rpcs={row.get('rpcs')} "
+                  f"sheds={row.get('sheds')} stalls={row.get('stalls')} "
+                  f"ring_depth={row.get('ring_depth')} "
+                  f"inflight={row.get('inflight')}")
     faults = snap.get("faults")
     if faults:
         print(f"faults ACTIVE: {faults}")

@@ -311,6 +311,19 @@ class DaemonConfig:
     cache_size: int = 50000  # reference default, example.conf:11
     debug: bool = False
 
+    # Multi-process front door (frontdoor.py): 0 = classic single-process
+    # serving (byte-identical to pre-frontdoor builds); N >= 1 spawns N
+    # acceptor worker processes sharing the gRPC listen port via
+    # SO_REUSEPORT, each handing parsed request columns to this engine
+    # process over a shared-memory ring (core/shm_ring.py).
+    frontdoor_workers: int = 0
+    # Slabs per worker ring == max in-flight RPCs per worker; beyond it
+    # workers shed in-band with shed_reason=ring_full.
+    shm_ring_slots: int = 64
+    # Slab size; the default fits any max-size (1MB) gRPC message in
+    # either record shape (raw bytes, or 1000-item columns + keys).
+    shm_slab_bytes: int = (1 << 20) + (1 << 16)
+
     # k8s discovery
     k8s_namespace: str = ""
     k8s_pod_ip: str = ""
@@ -454,6 +467,13 @@ def config_from_env(env_file: Optional[str] = None) -> DaemonConfig:
     c.advertise_address = _env("GUBER_ADVERTISE_ADDRESS", c.grpc_listen_address)
     c.cache_size = int(_env("GUBER_CACHE_SIZE", str(c.cache_size)))
     c.debug = _env("GUBER_DEBUG") in ("true", "1", "yes")
+
+    c.frontdoor_workers = env_int("GUBER_FRONTDOOR_WORKERS",
+                                  c.frontdoor_workers, minimum=0)
+    c.shm_ring_slots = env_int("GUBER_SHM_RING_SLOTS", c.shm_ring_slots,
+                               minimum=2)
+    c.shm_slab_bytes = env_int("GUBER_SHM_SLAB_BYTES", c.shm_slab_bytes,
+                               minimum=1 << 16)
 
     c.snapshot_dir = _env("GUBER_SNAPSHOT_DIR")
     c.snapshot_interval_ms = env_int("GUBER_SNAPSHOT_INTERVAL_MS",

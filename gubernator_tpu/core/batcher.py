@@ -159,6 +159,14 @@ class WindowBatcher:
             return None
         return await self.pipeline.submit_rpc(data, peer_mode=peer_mode)
 
+    async def submit_cols(self, cols: tuple, n: int):
+        """Frontdoor shm lane: serve worker-parsed request COLUMNS through
+        the pipeline (core/pipeline.py ColsJob); None => the hub runs the
+        engine-side Python fallback."""
+        if self.pipeline is None:
+            return None
+        return await self.pipeline.submit_cols(cols, n)
+
     def start_lockstep(self) -> None:
         """Begin the lockstep tick loop (mesh mode; call inside the loop)."""
         assert self.clock is not None

@@ -316,6 +316,44 @@ class ListJob:
         ]
 
 
+class ColsJob:
+    """Frontdoor shm lane (frontdoor.py): request columns a WORKER process
+    already parsed AND validated — native frontdoor_parse_req applies
+    exactly the RpcJob parser's acceptance rules, so a ColsJob never
+    range-falls-back.  Staged like a ListJob (pack_stack_fast over the
+    column 6-tuple, zero-copy views into the worker's shm slab) but
+    finished like an RpcJob: straight to C-encoded response bytes the hub
+    memcpys back into the slab.  Resolves to bytes, or None when the
+    drain routes it to fallback (the hub then runs the full Python path).
+
+    No _cols slot on purpose: leftover re-queues skip the materialization
+    copy because the slab stays valid until the hub completes the record."""
+
+    __slots__ = ("cols", "futs", "fut", "row", "lane", "pos", "n",
+                 "ctxs", "enq")
+
+    def __init__(self, cols: tuple, n: int, fut: asyncio.Future):
+        self.cols = cols
+        self.fut = fut
+        self.futs = None
+        self.ctxs = None
+        self.enq = 0.0
+        self.n = n
+        self.row = None
+        self.lane = None
+        self.pos = None
+
+    def columns(self):
+        return self.cols
+
+    def finish(self, pipeline, wflat, clflat, now) -> bytes:
+        resp_buf = pipeline._resp_buf(self.n * 64 + 64)
+        m = pipeline.engine.native.fastpath_encode_w(
+            wflat, self.cols[3], now, wflat.shape[-1], self.n,
+            self.row, self.lane, self.pos, resp_buf, climit=clflat)
+        return bytes(resp_buf[:m])
+
+
 class _GlobalJob:
     """GLOBAL singles riding the lockstep drain's composed psum window
     (full wire format — GLOBAL lanes are exempt from the compact range
@@ -620,6 +658,28 @@ class DispatchPipeline:
         if self.tracer is not None and job.ctx is not None:
             job.ctx.enqueued_at = job.enq
             self.tracer.record_span(job.ctx, "enqueue", job.enq, job.enq)
+        self._jobs.append(job)
+        self._pump()
+        return await fut
+
+    async def submit_cols(self, cols: tuple, n: int) -> Optional[bytes]:
+        """Serve worker-parsed GetRateLimitsReq COLUMNS (the frontdoor shm
+        lane): (key_bytes, key_ends, hits, limits, durations, algos) views
+        into the worker's slab pack-stack directly — parsed once, in the
+        worker, never re-materialized as Python objects.  None => the hub
+        must run the engine-side Python fallback.  COLS is only sound
+        standalone: pack_stack_fast never consults the ring, so installed
+        peers force the fallback (the hub mirrors this gate into the
+        status block so workers stop sending COLS records at all)."""
+        if not (self.enabled and self.rpc_enabled
+                and self.engine._compact_enabled) or self._closed:
+            return None
+        if self._ring_peers:
+            return None
+        self._loop = asyncio.get_running_loop()
+        fut = self._loop.create_future()
+        job = ColsJob(cols, n, fut)
+        job.enq = time.monotonic()
         self._jobs.append(job)
         self._pump()
         return await fut
@@ -1043,6 +1103,8 @@ class DispatchPipeline:
                     if not f.done():
                         f.set_result(r)
             else:
+                if isinstance(job, ColsJob):
+                    self.rpc_served += 1
                 if not job.fut.done():
                     job.fut.set_result(out)
         # ONE clock for control and observability: the drain wall time is
@@ -1164,9 +1226,9 @@ class DispatchPipeline:
                 raise
 
     def _route_fallback(self, job) -> None:
-        if isinstance(job, RpcJob):
+        if isinstance(job, (RpcJob, ColsJob)):
             if not job.fut.done():
-                job.fut.set_result(None)  # server runs the full path
+                job.fut.set_result(None)  # caller runs the full path
             return
         # list job needing the full path (legacy lane handles chunking,
         # full wire format, every semantic)

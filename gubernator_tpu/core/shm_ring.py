@@ -1,0 +1,489 @@
+"""Shared-memory rings for the multi-process front door.
+
+Each frontdoor worker process owns ONE shared-memory segment holding a
+pair of SPSC index rings plus a pool of preallocated columnar slabs (the
+window_buffers.py arena idea applied across a process boundary):
+
+  header     | submission ring | completion ring | slab pool
+  int64[64]  | int64[slots]    | int64[4*slots]  | slots * slab_bytes
+
+The worker is the single producer of the submission ring and the single
+consumer of the completion ring; the engine hub is the mirror image.  A
+record's life cycle:
+
+  worker: alloc() a free slab  ->  write the record (RAW bytes, or the
+  C-parsed request COLUMNS via frontdoor_parse_req writing straight into
+  the slab)  ->  submit(slot): publish the slot index
+  engine: pop() the index, read the record (columns are zero-copy numpy
+  views into the slab)  ->  serve it  ->  complete(slot, ...): write the
+  response bytes back INTO the same slab + publish a completion entry
+  worker: poll_completions() reads the response, frees the slab
+
+Slot indices travel through the rings; slabs return to the worker's free
+list only via a completion, so the engine may keep a slab's column views
+alive across drains (a leftover ColsJob re-staged by a later drain still
+reads valid memory) and a half-written record is never observed: the
+producer publishes its ring tail only AFTER the slab payload and the ring
+entry are fully written (aligned int64 stores; x86-TSO/acquire-release
+ordering is assumed, as everywhere numpy shares buffers across processes).
+
+No locks, no syscalls on the hot path, nothing pickled: the only
+cross-process traffic is the slab bytes themselves.
+"""
+
+from __future__ import annotations
+
+import time
+from multiprocessing import shared_memory
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+# record kinds (slab header [0]) — the frontdoor workers front EVERY
+# public service, so each PeersV1 RPC gets a RAW kind of its own
+KIND_RAW = 0          # serialized GetRateLimitsReq bytes
+KIND_COLS = 1         # C-parsed GetRateLimitsReq columns
+KIND_PEER_RL = 2      # serialized GetPeerRateLimitsReq (authoritative)
+KIND_TRANSFER = 3     # TransferBuckets payload
+KIND_REGISTER = 4     # serialized RegisterGlobalsReq
+KIND_APPLY_GREG = 5   # serialized ApplyGlobalRegistrationReq
+KIND_UPDATE_GLOBALS = 6  # serialized UpdatePeerGlobalsReq
+
+# completion status: 0 = OK (payload is response bytes); > 0 = the gRPC
+# status code the worker must abort with (payload is the utf-8 message)
+STATUS_OK = 0
+
+_HDR_I64 = 64          # header int64s (publish counters, cacheline-spread)
+_SUB_TAIL = 0          # worker-written
+_SUB_HEAD = 8          # engine-written
+_COMP_TAIL = 16        # engine-written
+_COMP_HEAD = 24        # worker-written
+_REC_HDR = 64          # per-slab record header bytes
+_COLS_BYTES_PER_ITEM = 40  # key_ends+hits+limits+durations (8*4) + algo+name_len (4*2)
+MAX_ITEMS = 1000       # MAX_BATCH_SIZE: the reference's per-RPC cap
+
+
+def _align(n: int, a: int = 64) -> int:
+    return (n + a - 1) // a * a
+
+
+class ShmRecord:
+    """One popped submission, engine side.  COLS records expose zero-copy
+    numpy views into the slab (valid until complete(slot, ...)); RAW
+    records carry a bytes copy of the payload."""
+
+    __slots__ = ("slot", "kind", "req_id", "deadline", "n", "cols",
+                 "name_lens", "payload")
+
+    def __init__(self, slot: int, kind: int, req_id: int, deadline: float):
+        self.slot = slot
+        self.kind = kind
+        self.req_id = req_id
+        self.deadline = deadline
+        self.n = 0
+        self.cols = None
+        self.name_lens = None
+        self.payload = b""
+
+
+try:  # pragma: no cover - stdlib-version dependent
+    from multiprocessing import resource_tracker
+except Exception:  # pragma: no cover
+    resource_tracker = None
+
+
+def _quiet_close(shm: shared_memory.SharedMemory) -> None:
+    """close() that tolerates still-exported views: popped records hand
+    out zero-copy numpy slices of the mapping, and a few may outlive the
+    channel (a leftover ColsJob, a late completion).  Transfer ownership
+    of the mapping to those views — it unmaps when the last one dies —
+    and leave nothing for SharedMemory.__del__ to trip over."""
+    try:
+        shm.close()
+    except BufferError:
+        shm._buf = None
+        shm._mmap = None
+    except Exception:
+        pass
+
+
+def _attach_untracked(name: str) -> shared_memory.SharedMemory:
+    """Attach WITHOUT registering with the resource tracker: on 3.10
+    attach registers too (no `track=` parameter yet), and the tracker
+    would unlink the engine-owned segment when the worker exits.
+    Suppressing the register beats register-then-unregister: the shared
+    tracker's cache is a SET, so two workers' register/unregister pairs
+    against the same segment (the status block) can interleave as
+    reg,reg,unreg,unreg — the registers collapse and the second
+    unregister KeyErrors in the tracker process."""
+    if resource_tracker is None:  # pragma: no cover
+        return shared_memory.SharedMemory(name=name)
+    orig = resource_tracker.register
+    resource_tracker.register = lambda *a, **k: None
+    try:
+        return shared_memory.SharedMemory(name=name)
+    finally:
+        resource_tracker.register = orig
+
+
+class WorkerChannel:
+    """One worker's submission/completion ring pair + slab pool.
+
+    The ENGINE creates (and eventually unlinks) the segment; the worker
+    attaches by name.  Exactly one thread on each side may touch each
+    ring: worker event loop = submission producer + completion consumer,
+    engine = submission consumer (hub consumer thread) + completion
+    producer (whichever engine thread finished the record — the hub
+    serializes completions through one writer)."""
+
+    def __init__(self, shm: shared_memory.SharedMemory, slots: int,
+                 slab_bytes: int, owner: bool):
+        self._shm = shm
+        self._owner = owner
+        self.slots = slots
+        self.slab_bytes = slab_bytes
+        buf = shm.buf
+        self._hdr = np.frombuffer(buf, np.int64, _HDR_I64, 0)
+        sub_off = _HDR_I64 * 8
+        self._sub = np.frombuffer(buf, np.int64, slots, sub_off)
+        comp_off = _align(sub_off + slots * 8)
+        self._comp = np.frombuffer(buf, np.int64, slots * 4, comp_off)
+        self._pool_off = _align(comp_off + slots * 32)
+        self._slabs = [
+            np.frombuffer(buf, np.uint8, slab_bytes,
+                          self._pool_off + i * slab_bytes)
+            for i in range(slots)
+        ]
+        # fixed columnar layout inside every slab (COLS records): column
+        # capacity first, the key region takes the rest
+        self.cap_items = min(
+            MAX_ITEMS,
+            max(0, (slab_bytes - _REC_HDR) // (_COLS_BYTES_PER_ITEM + 8)))
+        c = self.cap_items
+        self._ke_off = _REC_HDR
+        self._hi_off = _REC_HDR + 8 * c
+        self._li_off = _REC_HDR + 16 * c
+        self._du_off = _REC_HDR + 24 * c
+        self._al_off = _REC_HDR + 32 * c
+        self._nl_off = _REC_HDR + 36 * c
+        self._key_off = _REC_HDR + _COLS_BYTES_PER_ITEM * c
+        self.key_cap = slab_bytes - self._key_off
+        # worker-side free list (the worker is the only allocator; slots
+        # come back via completions)
+        self._free: List[int] = list(range(slots))
+
+    # ------------------------------------------------------------ lifecycle
+
+    @staticmethod
+    def segment_size(slots: int, slab_bytes: int) -> int:
+        sub_off = _HDR_I64 * 8
+        comp_off = _align(sub_off + slots * 8)
+        pool_off = _align(comp_off + slots * 32)
+        return pool_off + slots * slab_bytes
+
+    @classmethod
+    def create(cls, name: str, slots: int,
+               slab_bytes: int) -> "WorkerChannel":
+        slab_bytes = _align(slab_bytes)
+        size = cls.segment_size(slots, slab_bytes)
+        try:  # a crashed previous run may have leaked the name
+            stale = shared_memory.SharedMemory(name=name)
+            stale.close()
+            stale.unlink()
+        except FileNotFoundError:
+            pass
+        shm = shared_memory.SharedMemory(name=name, create=True, size=size)
+        ch = cls(shm, slots, slab_bytes, owner=True)
+        ch.reset()
+        return ch
+
+    @classmethod
+    def attach(cls, name: str, slots: int,
+               slab_bytes: int) -> "WorkerChannel":
+        shm = _attach_untracked(name)
+        return cls(shm, slots, _align(slab_bytes), owner=False)
+
+    def reset(self) -> None:
+        """Engine-side, with NO worker attached (before a spawn/respawn):
+        forget every in-flight record of the previous epoch."""
+        self._hdr[:] = 0
+        self._free = list(range(self.slots))
+
+    def close(self) -> None:
+        # drop our own numpy views before closing the mmap; popped
+        # records may still hold theirs — _quiet_close handles those
+        self._hdr = self._sub = self._comp = None
+        self._slabs = []
+        _quiet_close(self._shm)
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except Exception:
+                pass
+
+    # ------------------------------------------------------- worker producer
+
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    def alloc(self) -> Optional[int]:
+        """A free slab index, or None when every slab is in flight (the
+        ring-full condition: the caller sheds in-band, shed_reason
+        ring_full)."""
+        if not self._free:
+            return None
+        return self._free.pop()
+
+    def unalloc(self, slot: int) -> None:
+        """Return a slot that was alloc()ed but never submitted."""
+        self._free.append(slot)
+
+    def slab(self, slot: int) -> np.ndarray:
+        return self._slabs[slot]
+
+    def cols_views(self, slot: int):
+        """The slab's fixed-layout column buffers for frontdoor_parse_req
+        to write into directly (key_bytes, key_ends, hits, limits,
+        durations, algos, name_lens)."""
+        buf = self._shm.buf
+        base = self._pool_off + slot * self.slab_bytes
+        c = self.cap_items
+        return (
+            np.frombuffer(buf, np.uint8, self.key_cap, base + self._key_off),
+            np.frombuffer(buf, np.int64, c, base + self._ke_off),
+            np.frombuffer(buf, np.int64, c, base + self._hi_off),
+            np.frombuffer(buf, np.int64, c, base + self._li_off),
+            np.frombuffer(buf, np.int64, c, base + self._du_off),
+            np.frombuffer(buf, np.int32, c, base + self._al_off),
+            np.frombuffer(buf, np.int32, c, base + self._nl_off),
+        )
+
+    def _slab_hdr(self, slot: int) -> np.ndarray:
+        buf = self._shm.buf
+        return np.frombuffer(buf, np.int64, 8,
+                             self._pool_off + slot * self.slab_bytes)
+
+    def write_raw(self, slot: int, kind: int, req_id: int, payload: bytes,
+                  deadline: float = 0.0) -> bool:
+        """A RAW record: the original request bytes, shipped verbatim.
+        False when the payload cannot fit the slab."""
+        if len(payload) > self.slab_bytes - _REC_HDR:
+            return False
+        hdr = self._slab_hdr(slot)
+        hdr[0] = kind
+        hdr[1] = req_id
+        hdr[2] = len(payload)
+        hdr[3] = 0
+        hdr[4] = 0
+        hdr[5] = np.float64(deadline).view(np.int64)
+        self._slabs[slot][_REC_HDR:_REC_HDR + len(payload)] = \
+            np.frombuffer(payload, np.uint8)
+        return True
+
+    def commit_cols(self, slot: int, req_id: int, n: int, key_len: int,
+                    deadline: float = 0.0) -> None:
+        """Header for a COLS record whose columns frontdoor_parse_req
+        already wrote into cols_views(slot)."""
+        hdr = self._slab_hdr(slot)
+        hdr[0] = KIND_COLS
+        hdr[1] = req_id
+        hdr[2] = n
+        hdr[3] = key_len
+        hdr[4] = 0
+        hdr[5] = np.float64(deadline).view(np.int64)
+
+    def submit(self, slot: int) -> None:
+        """Publish a written record (cannot overflow: the ring holds as
+        many entries as there are slabs)."""
+        tail = int(self._hdr[_SUB_TAIL])
+        self._sub[tail % self.slots] = slot
+        self._hdr[_SUB_TAIL] = tail + 1  # publish AFTER payload + entry
+
+    def poll_completions(self) -> List[Tuple[int, int, int, bytes]]:
+        """Drain ready completions: [(req_id, status, code_payload...)].
+        Returns (req_id, status, payload) tuples; the slab is freed here,
+        so callers must take their bytes copy (we do)."""
+        out = []
+        head = int(self._hdr[_COMP_HEAD])
+        tail = int(self._hdr[_COMP_TAIL])
+        while head < tail:
+            e = (head % self.slots) * 4
+            slot = int(self._comp[e])
+            req_id = int(self._comp[e + 1])
+            status = int(self._comp[e + 2])
+            length = int(self._comp[e + 3])
+            payload = bytes(self._slabs[slot][:length])
+            self._free.append(slot)
+            head += 1
+            out.append((req_id, status, payload))
+        if out:
+            self._hdr[_COMP_HEAD] = head
+        return out
+
+    # ------------------------------------------------------- engine consumer
+
+    def sub_depth(self) -> int:
+        """Published-but-unconsumed submissions (ring depth gauge)."""
+        return int(self._hdr[_SUB_TAIL]) - int(self._hdr[_SUB_HEAD])
+
+    def inflight(self) -> int:
+        """Records the engine consumed but has not completed yet."""
+        return int(self._hdr[_SUB_HEAD]) - int(self._hdr[_COMP_TAIL])
+
+    def pop(self, max_n: int = 64) -> List["ShmRecord"]:
+        """Consume up to max_n published records (engine consumer thread).
+        The slot stays owned by the engine until complete(slot, ...)."""
+        out = []
+        head = int(self._hdr[_SUB_HEAD])
+        tail = int(self._hdr[_SUB_TAIL])
+        while head < tail and len(out) < max_n:
+            slot = int(self._sub[head % self.slots])
+            hdr = self._slab_hdr(slot)
+            kind = int(hdr[0])
+            rec = ShmRecord(
+                slot=slot, kind=kind, req_id=int(hdr[1]),
+                deadline=float(np.int64(hdr[5]).view(np.float64)))
+            if kind == KIND_COLS:
+                n = int(hdr[2])
+                key_len = int(hdr[3])
+                kb, ke, hi, li, du, al, nl = self.cols_views(slot)
+                rec.cols = (kb[:key_len], ke[:n], hi[:n], li[:n], du[:n],
+                            al[:n])
+                rec.name_lens = nl[:n]
+                rec.n = n
+            else:
+                rec.payload = bytes(self._slabs[slot][
+                    _REC_HDR:_REC_HDR + int(hdr[2])])
+            head += 1
+            out.append(rec)
+        if out:
+            self._hdr[_SUB_HEAD] = head
+        return out
+
+    def complete(self, slot: int, req_id: int, status: int,
+                 payload: bytes) -> None:
+        """Write the response over the record's slab and publish the
+        completion (engine side).  Oversized OK payloads degrade to an
+        in-band RESOURCE_EXHAUSTED so the worker always gets an answer."""
+        if len(payload) > self.slab_bytes:
+            status, payload = 8, b"response exceeds shm slab"  # RESOURCE_EXHAUSTED
+        self._slabs[slot][:len(payload)] = np.frombuffer(payload, np.uint8)
+        tail = int(self._hdr[_COMP_TAIL])
+        e = (tail % self.slots) * 4
+        self._comp[e] = slot
+        self._comp[e + 1] = req_id
+        self._comp[e + 2] = status
+        self._comp[e + 3] = len(payload)
+        self._hdr[_COMP_TAIL] = tail + 1  # publish last
+
+
+# ---------------------------------------------------------------- status block
+
+FLAG_DRAINING = 1 << 0    # engine entering shutdown: workers shed in-band
+FLAG_SATURATED = 1 << 1   # engine admission saturated: workers shed in-band
+FLAG_COLS_OK = 1 << 2     # engine accepts KIND_COLS (standalone + compact)
+
+_MSG_CAP = 256
+_W_ROW0 = 16              # per-worker rows start at this int64 index
+_W_STRIDE = 8
+# per-worker row fields; single writer per FIELD: the engine owns pid /
+# epoch / restarts, the worker owns port / rpcs / sheds / healthchecks /
+# stalls
+W_PID = 0
+W_PORT = 1
+W_EPOCH = 2
+W_RESTARTS = 3
+W_RPCS = 4
+W_SHEDS = 5
+W_HEALTHCHECKS = 6
+W_STALLS = 7
+
+
+class FrontdoorStatus:
+    """A tiny engine-owned shm block that lets the workers answer
+    HealthCheck locally (the satellite-2 isolation fix: health never
+    queues behind a saturated engine loop) and pick up the shared
+    draining/saturation shed signals without a round-trip.  Every int64
+    field has exactly one writer, so plain aligned stores suffice."""
+
+    def __init__(self, shm: shared_memory.SharedMemory, workers: int,
+                 owner: bool):
+        self._shm = shm
+        self._owner = owner
+        self.workers = workers
+        self._i = np.frombuffer(shm.buf, np.int64,
+                                _W_ROW0 + workers * _W_STRIDE, 0)
+        self._msg = np.frombuffer(
+            shm.buf, np.uint8, _MSG_CAP,
+            (_W_ROW0 + workers * _W_STRIDE) * 8)
+
+    @staticmethod
+    def segment_size(workers: int) -> int:
+        return (_W_ROW0 + workers * _W_STRIDE) * 8 + _MSG_CAP
+
+    @classmethod
+    def create(cls, name: str, workers: int) -> "FrontdoorStatus":
+        try:
+            stale = shared_memory.SharedMemory(name=name)
+            stale.close()
+            stale.unlink()
+        except FileNotFoundError:
+            pass
+        shm = shared_memory.SharedMemory(
+            name=name, create=True, size=cls.segment_size(workers))
+        st = cls(shm, workers, owner=True)
+        st._i[:] = 0
+        st._msg[:] = 0
+        return st
+
+    @classmethod
+    def attach(cls, name: str, workers: int) -> "FrontdoorStatus":
+        shm = _attach_untracked(name)
+        return cls(shm, workers, owner=False)
+
+    def close(self) -> None:
+        self._i = self._msg = None
+        _quiet_close(self._shm)
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except Exception:
+                pass
+
+    # engine-written fields: [0] flags, [1] health status, [2] peer count,
+    # [3] heartbeat (monotonic seconds bits), [4] health message length
+    def set_flag(self, flag: int, on: bool) -> None:
+        f = int(self._i[0])
+        self._i[0] = (f | flag) if on else (f & ~flag)
+
+    def flag(self, flag: int) -> bool:
+        return bool(int(self._i[0]) & flag)
+
+    def set_health(self, status: int, message: str, peer_count: int) -> None:
+        raw = message.encode()[:_MSG_CAP]
+        self._msg[:len(raw)] = np.frombuffer(raw, np.uint8)
+        self._i[1] = status
+        self._i[2] = peer_count
+        self._i[4] = len(raw)
+
+    def health(self) -> Tuple[int, str, int]:
+        ln = int(self._i[4])
+        return (int(self._i[1]),
+                bytes(self._msg[:ln]).decode("utf-8", "replace"),
+                int(self._i[2]))
+
+    def beat(self) -> None:
+        self._i[3] = np.float64(time.monotonic()).view(np.int64)
+
+    def heartbeat_age(self) -> float:
+        return time.monotonic() - float(np.int64(self._i[3]).view(np.float64))
+
+    # per-worker row accessors
+    def set_w(self, worker: int, field: int, value: int) -> None:
+        self._i[_W_ROW0 + worker * _W_STRIDE + field] = value
+
+    def get_w(self, worker: int, field: int) -> int:
+        return int(self._i[_W_ROW0 + worker * _W_STRIDE + field])
+
+    def bump_w(self, worker: int, field: int, n: int = 1) -> None:
+        self._i[_W_ROW0 + worker * _W_STRIDE + field] += n

@@ -46,6 +46,7 @@ class Daemon:
         self.conf = conf
         self.instance: Optional[Instance] = None
         self.grpc: Optional[GrpcServer] = None
+        self.frontdoor = None  # FrontdoorHub when GUBER_FRONTDOOR_WORKERS > 0
         self.http: Optional[HttpGateway] = None
         self.pool = None
         self.monitor = None  # net/health.py HeartbeatMonitor (static pools)
@@ -155,9 +156,30 @@ class Daemon:
             log.info("snapshots -> %s every %dms", c.snapshot_dir,
                      c.snapshot_interval_ms)
 
-        self.grpc = GrpcServer(self.instance, c.grpc_listen_address)
-        await self.grpc.start()
-        log.info("gRPC listening on %s", self.grpc.address)
+        if c.frontdoor_workers > 0 and mesh_peers is None:
+            # multi-process front door (frontdoor.py): N acceptor worker
+            # processes share the gRPC port via SO_REUSEPORT and hand
+            # records to this engine over shm rings; this process binds
+            # no public gRPC port of its own.  Mesh mode keeps the
+            # classic in-process server: lockstep ticks own the loop.
+            from gubernator_tpu.frontdoor import FrontdoorHub
+            self.frontdoor = FrontdoorHub(
+                self.instance, workers=c.frontdoor_workers,
+                ring_slots=c.shm_ring_slots, slab_bytes=c.shm_slab_bytes,
+                listen_address=c.grpc_listen_address)
+            await self.frontdoor.start()
+            # surfaced in /v1/admin/debug + metrics like any subsystem
+            self.instance.frontdoor = self.frontdoor
+            self.instance.metrics.watch_frontdoor(self.frontdoor)
+            log.info("frontdoor: %d workers on %s (engine pid %d)",
+                     c.frontdoor_workers, self.frontdoor.address,
+                     os.getpid())
+        else:
+            if c.frontdoor_workers > 0:
+                log.warning("GUBER_FRONTDOOR_WORKERS ignored in mesh mode")
+            self.grpc = GrpcServer(self.instance, c.grpc_listen_address)
+            await self.grpc.start()
+            log.info("gRPC listening on %s", self.grpc.address)
 
         static_peers = os.environ.get("GUBER_STATIC_PEERS", "")
         if mesh_peers is not None:
@@ -261,6 +283,10 @@ class Daemon:
 
     async def _drain_requests(self) -> None:
         self._phase("drain")
+        if self.frontdoor is not None:
+            # workers shed new work in-band (reason `draining`) without a
+            # ring round-trip from here on
+            self.frontdoor.set_draining()
         if self.instance is None:
             return
         try:
@@ -318,6 +344,8 @@ class Daemon:
             await self.pool.close()
         if self.http is not None:
             await self.http.stop()
+        if self.frontdoor is not None:
+            await self.frontdoor.stop()
         if self.grpc is not None:
             await self.grpc.stop()
         if self.instance is not None:

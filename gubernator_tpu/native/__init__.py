@@ -101,6 +101,11 @@ def _load() -> Optional[ctypes.CDLL]:
             i64p, i64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
             i32p, i32p, i32p, i64p, u8p, ctypes.c_int64,
         ]
+        lib.frontdoor_parse_req.restype = ctypes.c_int64
+        lib.frontdoor_parse_req.argtypes = [
+            u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            u8p, i64p, i64p, i64p, i64p, i32p, i32p,
+        ]
         u64p = ctypes.POINTER(ctypes.c_uint64)
         lib.router_export_keys.restype = ctypes.c_int64
         lib.router_export_keys.argtypes = [
@@ -125,6 +130,30 @@ def available() -> bool:
 
 def _ptr(a: np.ndarray, ctype):
     return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def frontdoor_parse_req(data: bytes, key_bytes: np.ndarray,
+                        key_ends: np.ndarray, hits: np.ndarray,
+                        limits: np.ndarray, durations: np.ndarray,
+                        algos: np.ndarray, name_lens: np.ndarray,
+                        max_items: int) -> int:
+    """Stateless worker-side parse: serialized GetRateLimitsReq -> request
+    columns in caller-owned buffers (the frontdoor worker writes straight
+    into its shared-memory slab, core/shm_ring.py).  No Router* involved —
+    frontdoor workers never hold engine state.  Returns n >= 0 (requests
+    parsed) or a negative fallback code (the worker then ships the raw
+    bytes instead); see host_router.cc frontdoor_parse_req.  Callers must
+    check available() first."""
+    lib = _load()
+    if lib is None:
+        return -1
+    buf = ctypes.cast(ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8))
+    return lib.frontdoor_parse_req(
+        buf, len(data), max_items, key_bytes.nbytes,
+        _ptr(key_bytes, ctypes.c_uint8), _ptr(key_ends, ctypes.c_int64),
+        _ptr(hits, ctypes.c_int64), _ptr(limits, ctypes.c_int64),
+        _ptr(durations, ctypes.c_int64), _ptr(algos, ctypes.c_int32),
+        _ptr(name_lens, ctypes.c_int32))
 
 
 class NativeRouter:

@@ -1211,6 +1211,86 @@ int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
   return n;
 }
 
+// Stateless pass-1 of fastpath_parse_stack for the frontdoor workers
+// (core/shm_ring.py): parse + validate a serialized GetRateLimitsReq into
+// request COLUMNS written to caller-owned (shared-memory) buffers, with
+// exactly the acceptance rules of the engine's native RPC lane — so a
+// worker-parsed RPC never range-falls-back inside the engine, and a
+// rejected one ships as RAW bytes instead.  Touches NO router state:
+// workers run this without a Router* (they never see the engine's
+// tables), and the engine re-stages the columns via router_pack_stack.
+// key_bytes gets concat(name + '_' + unique_key) per item (client.go:33-35,
+// the same assembled hash key router_pack_stack hashes); key_ends are
+// cumulative exclusive offsets; name_lens keeps each item's name length so
+// the engine's rare fallback lane can split the assembled key back into
+// (name, unique_key) exactly — COLS records then never need the original
+// bytes appended.
+// Returns the request count n >= 0, or:
+//   -1  malformed protobuf
+//   -2  a request needs the full path (behavior/algorithm/validation/range)
+//   -3  more than max_items requests
+//   -4  concatenated keys exceed key_cap bytes
+int64_t frontdoor_parse_req(const uint8_t* buf, int64_t len,
+                            int64_t max_items, int64_t key_cap,
+                            uint8_t* key_bytes, int64_t* key_ends,
+                            int64_t* hits, int64_t* limits,
+                            int64_t* durations, int32_t* algos,
+                            int32_t* name_lens) {
+  const uint8_t* p = buf;
+  const uint8_t* end = buf + len;
+  int64_t n = 0;
+  int64_t koff = 0;
+  while (p < end) {
+    uint64_t tag;
+    if (!read_varint(&p, end, &tag)) return -1;
+    if (tag != ((1u << 3) | 2)) {  // only field 1: repeated RateLimitReq
+      int wt = (int)(tag & 7);
+      if (wt == 0) {
+        uint64_t dummy;
+        if (!read_varint(&p, end, &dummy)) return -1;
+      } else if (wt == 2) {
+        uint64_t l;
+        if (!read_varint(&p, end, &l) || l > (uint64_t)(end - p))
+          return -1;
+        p += l;
+      } else {
+        return -1;
+      }
+      continue;
+    }
+    uint64_t mlen;
+    if (!read_varint(&p, end, &mlen) || mlen > (uint64_t)(end - p))
+      return -1;
+    if (n >= max_items) return -3;
+    ParsedItem it;
+    uint64_t behavior;
+    if (!parse_item(p, p + mlen, &it, &behavior)) return -1;
+    p += mlen;
+
+    if (it.name_len == 0 || it.key_len == 0) return -2;
+    if (behavior != 0) return -2;  // BATCHING only
+    if (it.algo > 1) return -2;
+    if (it.hits < 0 || it.hits >= COMPACT_MAX_HITS) return -2;
+    if (it.limit < 0 || it.limit >= COMPACT_MAX_LIMIT) return -2;
+    if (it.duration < 0 || it.duration >= COMPACT_MAX_DURATION) return -2;
+
+    int64_t kl = it.name_len + 1 + it.key_len;
+    if (koff + kl > key_cap) return -4;
+    memcpy(key_bytes + koff, it.name, it.name_len);
+    key_bytes[koff + it.name_len] = '_';
+    memcpy(key_bytes + koff + it.name_len + 1, it.key, it.key_len);
+    koff += kl;
+    key_ends[n] = koff;
+    hits[n] = it.hits;
+    limits[n] = it.limit;
+    durations[n] = it.duration;
+    algos[n] = (int32_t)it.algo;
+    name_lens[n] = (int32_t)it.name_len;
+    n++;
+  }
+  return n;
+}
+
 // Columnar-input sibling of fastpath_parse_stack for already-parsed request
 // lists (the batcher's Python-side jobs).  Same drain protocol, same
 // monotonic spill, same no-side-effects-on-fallback guarantee.

@@ -159,6 +159,9 @@ def build_debug_snapshot(instance) -> dict:
     monitor = getattr(instance, "monitor", None)
     if monitor is not None:
         out["health"] = monitor.snapshot()
+    frontdoor = getattr(instance, "frontdoor", None)
+    if frontdoor is not None:
+        out["frontdoor"] = _jsonable(frontdoor.debug_snapshot())
     from gubernator_tpu.net.faults import FAULTS
     if FAULTS.enabled:
         out["faults"] = FAULTS.describe()

@@ -372,6 +372,48 @@ class Metrics:
             ["slo"],
             registry=self.registry,
         )
+        # multi-process front door (frontdoor.py): per-worker counters
+        # live in the shared-memory status block and aggregate here at
+        # scrape time (watch_frontdoor's delta pattern), like the
+        # reference's collect-at-scrape stats handler
+        self.frontdoor_workers = Gauge(
+            "guber_tpu_frontdoor_workers",
+            "Configured frontdoor acceptor worker processes "
+            "(0 = classic single-process serving).",
+            registry=self.registry,
+        )
+        self.frontdoor_rpcs = Counter(
+            "guber_tpu_frontdoor_rpcs_total",
+            "RPCs completed through the frontdoor shm ring, per worker.",
+            ["worker"],
+            registry=self.registry,
+        )
+        self.frontdoor_sheds = Counter(
+            "guber_tpu_frontdoor_sheds_total",
+            "Requests shed in-band by frontdoor workers (draining / "
+            "saturated / ring_full), per worker.",
+            ["worker"],
+            registry=self.registry,
+        )
+        self.frontdoor_restarts = Counter(
+            "guber_tpu_frontdoor_restarts_total",
+            "Frontdoor worker crash-restarts performed by the hub.",
+            registry=self.registry,
+        )
+        self.shm_ring_depth = Gauge(
+            "guber_tpu_shm_ring_depth",
+            "Published-but-unconsumed submissions in each worker's shm "
+            "ring at scrape time.",
+            ["worker"],
+            registry=self.registry,
+        )
+        self.shm_ring_stalls = Counter(
+            "guber_tpu_shm_ring_stalls_total",
+            "Producer-side ring-full events (every slab in flight; the "
+            "worker shed in-band with reason ring_full), per worker.",
+            ["worker"],
+            registry=self.registry,
+        )
         self._stage_rings: Dict[str, _StageRing] = {}
         self._stage_rings_lock = threading.Lock()
         self._slo_sink = None
@@ -439,6 +481,42 @@ class Metrics:
                             slo=name, window=win).set(burn)
                     self.slo_firing.labels(slo=name).set(
                         1 if obj["firing"] else 0)
+
+        self.add_scrape_hook(refresh)
+
+    def watch_frontdoor(self, hub) -> None:
+        """Export the frontdoor hub's per-worker shared-memory counters at
+        scrape time: the workers bump raw int64 cells in the status block
+        (no prometheus client in the worker processes), and this hook
+        advances the engine-side counters by the delta since the last
+        scrape — the same pattern watch_engine uses for cache stats."""
+        from gubernator_tpu.core import shm_ring as _sr
+        last: Dict[tuple, int] = {}
+
+        def _delta(w: str, field: int, counter) -> None:
+            cur = hub.status.get_w(int(w), field)
+            prev = last.get((w, field), 0)
+            if cur > prev:
+                counter.labels(worker=w).inc(cur - prev)
+                last[(w, field)] = cur
+
+        def refresh():
+            self.frontdoor_workers.set(hub.workers)
+            if hub.status is None:
+                return
+            for i in range(hub.workers):
+                w = str(i)
+                _delta(w, _sr.W_RPCS, self.frontdoor_rpcs)
+                _delta(w, _sr.W_SHEDS, self.frontdoor_sheds)
+                _delta(w, _sr.W_STALLS, self.shm_ring_stalls)
+                if hub.chans:
+                    self.shm_ring_depth.labels(worker=w).set(
+                        hub.chans[i].sub_depth())
+            cur = hub.restarts
+            prev = last.get(("", "restarts"), 0)
+            if cur > prev:
+                self.frontdoor_restarts.inc(cur - prev)
+                last[("", "restarts")] = cur
 
         self.add_scrape_hook(refresh)
 

@@ -33,6 +33,10 @@ SHED_QUEUE_FULL = "queue_full"
 SHED_DEADLINE = "deadline"
 SHED_BREAKER_OPEN = "breaker_open"
 SHED_DRAINING = "draining"
+# frontdoor-only (frontdoor.py): every slab of the worker's shm ring is
+# in flight, so the worker sheds in-band without a cross-process
+# round-trip — the CONCUR-style frontend/backend coupling signal.
+SHED_RING_FULL = "ring_full"
 
 
 def shed_response(req: RateLimitReq, reason: str) -> RateLimitResp:

@@ -4,6 +4,12 @@ The reference takes a caller-owned *grpc.Server (config.go:30-31) and
 registers onto it (gubernator.go:66-67); here the server wrapper owns a
 grpc.aio server bound to one address, with per-RPC metrics equivalent to the
 reference's stats-handler pipeline (prometheus.go:104-145).
+
+The RPC bodies live in module-level serve_* functions taking (instance,
+payload, context) so the frontdoor engine consumer (frontdoor.py) runs
+LITERALLY the same code for records arriving over the shm ring as the
+in-process servicers run for direct connections — byte-identical responses
+in both serving modes by construction, not by parallel implementation.
 """
 
 from __future__ import annotations
@@ -38,6 +44,168 @@ def _traceparent_from(context) -> Optional[str]:
     return None
 
 
+async def serve_get_rate_limits(inst: Instance, data: bytes,
+                                context) -> bytes:
+    """V1.GetRateLimits engine-side body: bytes in, response bytes out.
+    `context` only needs time_remaining() and abort() (which must raise) —
+    satisfied by both grpc.aio contexts and the frontdoor shim."""
+    m = inst.metrics
+    start = time.monotonic()
+    # QoS: propagate the client's gRPC deadline into admission control,
+    # and BYPASS the bytes-level native lane while the admission queue
+    # is saturated — sheds must be decided per item on the Python path
+    # so the response carries shed_reason metadata in-band
+    qos_saturated = (inst.qos is not None
+                     and inst.qos.admission.saturated)
+    if (not inst.mesh_mode and not qos_saturated
+            and len(data) >= FASTPATH_MIN_BYTES):
+        # native RPC lane: C parse -> stacked compact dispatch -> C
+        # encode (core/pipeline.py).  In cluster mode the C parser
+        # classifies items per key against the installed ring and
+        # forwards non-owned items to their peers; the drain re-checks
+        # the gate on the engine thread, so a membership change that
+        # races this RPC falls back to the full path below instead of
+        # deciding keys this node does not own
+        out = await inst.batcher.submit_rpc(data)
+        if out is not None:
+            m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start,
+                          ok=True)
+            return out
+    try:
+        request = pb.GetRateLimitsReq.FromString(data)
+    except Exception:
+        m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=False)
+        await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                            "malformed GetRateLimitsReq")
+    deadline = None
+    if inst.qos is not None:
+        remaining = None
+        tr = getattr(context, "time_remaining", None)
+        if callable(tr):
+            remaining = tr()
+        deadline = inst.qos.deadline_from_timeout(remaining)
+    try:
+        resps = await inst.get_rate_limits(
+            [pb.req_from_pb(r) for r in request.requests],
+            deadline=deadline)
+    except BatchTooLargeError as e:
+        m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=False)
+        await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
+    m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=True)
+    return pb.GetRateLimitsResp(
+        responses=[pb.resp_to_pb(r) for r in resps]).SerializeToString()
+
+
+async def serve_peer_rate_limits(inst: Instance, data: bytes,
+                                 context) -> bytes:
+    """PeersV1.GetPeerRateLimits engine-side body."""
+    m = inst.metrics
+    start = time.monotonic()
+    if not inst.mesh_mode:
+        # authoritative relay through the native lane: identical wire
+        # shape to GetRateLimits, ring ignored (we are the owner for
+        # whatever arrives, gubernator.go:210-227)
+        out = await inst.batcher.submit_rpc(data, peer_mode=True)
+        if out is not None:
+            m.observe_rpc("/pb.gubernator.PeersV1/GetPeerRateLimits",
+                          start, ok=True)
+            return out
+    try:
+        request = pb.GetPeerRateLimitsReq.FromString(data)
+    except Exception:
+        m.observe_rpc("/pb.gubernator.PeersV1/GetPeerRateLimits", start,
+                      ok=False)
+        await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                            "malformed GetPeerRateLimitsReq")
+    try:
+        resps = await inst.get_peer_rate_limits(
+            [pb.req_from_pb(r) for r in request.requests])
+    except BatchTooLargeError as e:
+        m.observe_rpc("/pb.gubernator.PeersV1/GetPeerRateLimits", start,
+                      ok=False)
+        await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
+    m.observe_rpc("/pb.gubernator.PeersV1/GetPeerRateLimits", start, ok=True)
+    return pb.GetPeerRateLimitsResp(
+        rate_limits=[pb.resp_to_pb(r) for r in resps]).SerializeToString()
+
+
+async def serve_transfer_buckets(inst: Instance, data: bytes,
+                                 context) -> bytes:
+    """Bucket-migration import lane (state/migrate.py): bytes in
+    (versioned JSON rows), ack bytes out."""
+    from gubernator_tpu.state.migrate import MigrationError
+    start = time.monotonic()
+    m = inst.metrics
+    try:
+        ack = await inst.transfer_buckets(data)
+    except MigrationError as e:
+        m.observe_rpc("/pb.gubernator.PeersV1/TransferBuckets", start,
+                      ok=False)
+        await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+    except Exception as e:
+        m.observe_rpc("/pb.gubernator.PeersV1/TransferBuckets", start,
+                      ok=False)
+        await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+    m.observe_rpc("/pb.gubernator.PeersV1/TransferBuckets", start,
+                  ok=True)
+    return ack
+
+
+async def serve_register_globals(inst: Instance, request,
+                                 context) -> "pb.RegisterGlobalsResp":
+    start = time.monotonic()
+    m = inst.metrics
+    specs = [(s.key, s.limit, s.duration, int(s.algorithm))
+             for s in request.specs]
+    try:
+        await inst.register_globals(specs)
+    except Exception as e:
+        m.observe_rpc("/pb.gubernator.PeersV1/RegisterGlobals", start,
+                      ok=False)
+        await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+    m.observe_rpc("/pb.gubernator.PeersV1/RegisterGlobals", start,
+                  ok=True)
+    return pb.RegisterGlobalsResp()
+
+
+async def serve_apply_global_registration(
+        inst: Instance, request,
+        context) -> "pb.ApplyGlobalRegistrationResp":
+    start = time.monotonic()
+    m = inst.metrics
+    specs = [(s.key, s.limit, s.duration, int(s.algorithm))
+             for s in request.specs]
+    try:
+        await inst.apply_global_registration(
+            specs, request.now, request.activate)
+    except Exception as e:
+        m.observe_rpc("/pb.gubernator.PeersV1/ApplyGlobalRegistration",
+                      start, ok=False)
+        await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+    m.observe_rpc("/pb.gubernator.PeersV1/ApplyGlobalRegistration",
+                  start, ok=True)
+    return pb.ApplyGlobalRegistrationResp()
+
+
+async def serve_update_peer_globals(inst: Instance, request,
+                                    context) -> "pb.UpdatePeerGlobalsResp":
+    from gubernator_tpu.api.types import UpdatePeerGlobal
+    start = time.monotonic()
+    ups = [
+        UpdatePeerGlobal(
+            key=g.key,
+            status=pb.resp_from_pb(g.status),
+            algorithm=g.algorithm,
+            duration=g.duration,
+        )
+        for g in request.globals
+    ]
+    await inst.update_peer_globals(ups)
+    inst.metrics.observe_rpc(
+        "/pb.gubernator.PeersV1/UpdatePeerGlobals", start, ok=True)
+    return pb.UpdatePeerGlobalsResp()
+
+
 class _V1Servicer:
     def __init__(self, instance: Instance):
         self.instance = instance
@@ -45,57 +213,9 @@ class _V1Servicer:
     async def GetRateLimits(self, data: bytes, context):
         tracer = self.instance.tracer
         if tracer is None or not tracer.enabled:
-            return await self._get_rate_limits(data, context)
+            return await serve_get_rate_limits(self.instance, data, context)
         with tracer.start_trace("rpc", _traceparent_from(context)):
-            return await self._get_rate_limits(data, context)
-
-    async def _get_rate_limits(self, data: bytes, context):
-        inst = self.instance
-        m = inst.metrics
-        start = time.monotonic()
-        # QoS: propagate the client's gRPC deadline into admission control,
-        # and BYPASS the bytes-level native lane while the admission queue
-        # is saturated — sheds must be decided per item on the Python path
-        # so the response carries shed_reason metadata in-band
-        qos_saturated = (inst.qos is not None
-                         and inst.qos.admission.saturated)
-        if (not inst.mesh_mode and not qos_saturated
-                and len(data) >= FASTPATH_MIN_BYTES):
-            # native RPC lane: C parse -> stacked compact dispatch -> C
-            # encode (core/pipeline.py).  In cluster mode the C parser
-            # classifies items per key against the installed ring and
-            # forwards non-owned items to their peers; the drain re-checks
-            # the gate on the engine thread, so a membership change that
-            # races this RPC falls back to the full path below instead of
-            # deciding keys this node does not own
-            out = await inst.batcher.submit_rpc(data)
-            if out is not None:
-                m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start,
-                              ok=True)
-                return out
-        try:
-            request = pb.GetRateLimitsReq.FromString(data)
-        except Exception:
-            m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=False)
-            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
-                                "malformed GetRateLimitsReq")
-        deadline = None
-        if inst.qos is not None:
-            remaining = None
-            tr = getattr(context, "time_remaining", None)
-            if callable(tr):
-                remaining = tr()
-            deadline = inst.qos.deadline_from_timeout(remaining)
-        try:
-            resps = await inst.get_rate_limits(
-                [pb.req_from_pb(r) for r in request.requests],
-                deadline=deadline)
-        except BatchTooLargeError as e:
-            m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=False)
-            await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
-        m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=True)
-        return pb.GetRateLimitsResp(
-            responses=[pb.resp_to_pb(r) for r in resps]).SerializeToString()
+            return await serve_get_rate_limits(self.instance, data, context)
 
     async def HealthCheck(self, request, context):
         # the reference's stats-handler observes EVERY RPC, HealthCheck
@@ -118,117 +238,40 @@ class _PeersServicer:
         # SAME trace (one trace across owner and non-owner)
         tracer = self.instance.tracer
         if tracer is None or not tracer.enabled:
-            return await self._get_peer_rate_limits(data, context)
+            return await serve_peer_rate_limits(self.instance, data, context)
         with tracer.start_trace("peer_rpc", _traceparent_from(context)):
-            return await self._get_peer_rate_limits(data, context)
-
-    async def _get_peer_rate_limits(self, data: bytes, context):
-        inst = self.instance
-        m = inst.metrics
-        start = time.monotonic()
-        if not inst.mesh_mode:
-            # authoritative relay through the native lane: identical wire
-            # shape to GetRateLimits, ring ignored (we are the owner for
-            # whatever arrives, gubernator.go:210-227)
-            out = await inst.batcher.submit_rpc(data, peer_mode=True)
-            if out is not None:
-                m.observe_rpc("/pb.gubernator.PeersV1/GetPeerRateLimits",
-                              start, ok=True)
-                return out
-        try:
-            request = pb.GetPeerRateLimitsReq.FromString(data)
-        except Exception:
-            m.observe_rpc("/pb.gubernator.PeersV1/GetPeerRateLimits", start,
-                          ok=False)
-            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
-                                "malformed GetPeerRateLimitsReq")
-        try:
-            resps = await self.instance.get_peer_rate_limits(
-                [pb.req_from_pb(r) for r in request.requests])
-        except BatchTooLargeError as e:
-            m.observe_rpc("/pb.gubernator.PeersV1/GetPeerRateLimits", start, ok=False)
-            await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
-        m.observe_rpc("/pb.gubernator.PeersV1/GetPeerRateLimits", start, ok=True)
-        return pb.GetPeerRateLimitsResp(
-            rate_limits=[pb.resp_to_pb(r) for r in resps]).SerializeToString()
+            return await serve_peer_rate_limits(self.instance, data, context)
 
     async def TransferBuckets(self, data: bytes, context):
-        """Bucket-migration import lane (state/migrate.py): bytes in
-        (versioned JSON rows), ack bytes out."""
-        from gubernator_tpu.state.migrate import MigrationError
-        start = time.monotonic()
-        m = self.instance.metrics
-        try:
-            ack = await self.instance.transfer_buckets(data)
-        except MigrationError as e:
-            m.observe_rpc("/pb.gubernator.PeersV1/TransferBuckets", start,
-                          ok=False)
-            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        except Exception as e:
-            m.observe_rpc("/pb.gubernator.PeersV1/TransferBuckets", start,
-                          ok=False)
-            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
-        m.observe_rpc("/pb.gubernator.PeersV1/TransferBuckets", start,
-                      ok=True)
-        return ack
+        return await serve_transfer_buckets(self.instance, data, context)
 
     async def RegisterGlobals(self, request, context):
-        start = time.monotonic()
-        m = self.instance.metrics
-        specs = [(s.key, s.limit, s.duration, int(s.algorithm))
-                 for s in request.specs]
-        try:
-            await self.instance.register_globals(specs)
-        except Exception as e:
-            m.observe_rpc("/pb.gubernator.PeersV1/RegisterGlobals", start,
-                          ok=False)
-            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
-        m.observe_rpc("/pb.gubernator.PeersV1/RegisterGlobals", start,
-                      ok=True)
-        return pb.RegisterGlobalsResp()
+        return await serve_register_globals(self.instance, request, context)
 
     async def ApplyGlobalRegistration(self, request, context):
-        start = time.monotonic()
-        m = self.instance.metrics
-        specs = [(s.key, s.limit, s.duration, int(s.algorithm))
-                 for s in request.specs]
-        try:
-            await self.instance.apply_global_registration(
-                specs, request.now, request.activate)
-        except Exception as e:
-            m.observe_rpc("/pb.gubernator.PeersV1/ApplyGlobalRegistration",
-                          start, ok=False)
-            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
-        m.observe_rpc("/pb.gubernator.PeersV1/ApplyGlobalRegistration",
-                      start, ok=True)
-        return pb.ApplyGlobalRegistrationResp()
+        return await serve_apply_global_registration(
+            self.instance, request, context)
 
     async def UpdatePeerGlobals(self, request, context):
-        from gubernator_tpu.api.types import UpdatePeerGlobal
-        start = time.monotonic()
-        ups = [
-            UpdatePeerGlobal(
-                key=g.key,
-                status=pb.resp_from_pb(g.status),
-                algorithm=g.algorithm,
-                duration=g.duration,
-            )
-            for g in request.globals
-        ]
-        await self.instance.update_peer_globals(ups)
-        self.instance.metrics.observe_rpc(
-            "/pb.gubernator.PeersV1/UpdatePeerGlobals", start, ok=True)
-        return pb.UpdatePeerGlobalsResp()
+        return await serve_update_peer_globals(
+            self.instance, request, context)
 
 
 class GrpcServer:
     def __init__(self, instance: Instance, address: str,
-                 max_message_mb: int = 1):
+                 max_message_mb: int = 1,
+                 reuse_port: Optional[bool] = None):
         self.instance = instance
         # 1MB max receive, like the reference (cmd/gubernator/main.go:59-61)
-        self.server = grpc.aio.server(options=[
+        options = [
             ("grpc.max_receive_message_length", max_message_mb * 1024 * 1024),
-        ])
+        ]
+        if reuse_port is not None:
+            # frontdoor workers set this explicitly: True shards one
+            # listening port across worker processes (kernel-level accept
+            # balancing), False forces distinct per-worker ports
+            options.append(("grpc.so_reuseport", 1 if reuse_port else 0))
+        self.server = grpc.aio.server(options=options)
         add_v1_servicer(self.server, _V1Servicer(instance))
         add_peers_servicer(self.server, _PeersServicer(instance))
         self.port = self.server.add_insecure_port(address)

@@ -1,20 +1,32 @@
-"""Bench-regression gate: fresh CPU smoke vs the best prior round.
+"""Bench-regression gate: fresh CPU smoke vs this HOST's best prior run.
 
 `make bench-smoke` runs bench.py on the CPU backend (GUBER_BENCH_PLATFORM
 =cpu — same small shapes the tunnel-fallback smoke tiers use) and diffs
-the fresh throughput against the BEST prior BENCH_r*.json record in the
-repo root.  A regression past the noise floor (default 10%, CPU smoke
-numbers jitter) on either gated metric fails the build loudly:
+the fresh throughput against the best-of baseline stashed for THIS host
+(`.bench_baseline_<fingerprint>.json` next to the BENCH records; the
+fingerprint hashes nproc + the CPU model string).  Keying by host keeps
+the gate honest when the repo moves between boxes: numbers measured on a
+96-core builder must never gate a laptop, and vice versa.
+
+  * first run on a host: the fresh numbers anchor the stash, exit 0;
+  * later runs compare against the stash and RAISE it when fresh numbers
+    beat it (best-of, so the gate catches a regression even when the
+    previous round already regressed);
+  * GUBER_BENCH_REBASE=1 re-anchors the stash to the fresh run (after a
+    deliberate trade-off or a host change that kept the fingerprint).
+
+A regression past the noise floor (default 10%, CPU smoke numbers
+jitter) on either gated metric fails the build loudly:
 
   * e2e_decisions_per_sec     the serving headline (client -> response)
   * device_decisions_per_sec  the raw drain-window throughput
   * host_decisions_per_sec    the pipelined host path (RPC bytes -> C
                               parse -> stacked dispatch -> C encode)
 
-Prior rounds are read defensively: rc != 0 or an empty `parsed` is
-skipped (r01/r02 are exactly that), and CPU numbers may live at the top
-level (explicit GUBER_BENCH_PLATFORM=cpu run) or nested under
-`cpu_smoke` (a tunnel-fallback record like r05) — both are understood.
+Prior BENCH_r*.json rounds are still read (defensively: rc != 0 or an
+empty `parsed` is skipped, CPU numbers may live at the top level or
+nested under `cpu_smoke`) but only for CONTEXT in the log — they carry
+no host fingerprint, so they never gate.
 
   python scripts/bench_compare.py                    # run + compare
   python scripts/bench_compare.py --fresh-json F     # compare-only (tests)
@@ -34,6 +46,56 @@ import sys
 
 GATED_METRICS = ("e2e_decisions_per_sec", "device_decisions_per_sec",
                  "host_decisions_per_sec")
+
+
+def host_fingerprint() -> tuple[str, str]:
+    """(12-hex fingerprint, human-readable description) of this box:
+    nproc + the CPU model string.  Containers on the same machine class
+    share it; moving to different silicon changes it, detaching the
+    stash automatically."""
+    import hashlib
+    model = "unknown-cpu"
+    try:
+        with open("/proc/cpuinfo") as f:
+            lines = f.read().splitlines()
+        for key in ("model name", "hardware", "cpu model"):
+            for line in lines:
+                if line.lower().startswith(key) and ":" in line:
+                    model = line.split(":", 1)[1].strip() or model
+                    break
+            if model != "unknown-cpu":
+                break
+    except OSError:
+        pass
+    nproc = os.cpu_count() or 1
+    desc = f"{nproc}x {model}"
+    fp = hashlib.sha256(f"{nproc}|{model}".encode()).hexdigest()[:12]
+    return fp, desc
+
+
+def stash_path(bench_dir: str, fp: str) -> str:
+    return os.path.join(bench_dir, f".bench_baseline_{fp}.json")
+
+
+def load_stash(path: str) -> dict:
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        metrics = rec.get("metrics")
+        return rec if isinstance(metrics, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def write_stash(path: str, fp: str, desc: str, metrics: dict) -> None:
+    import time
+    with open(path, "w") as f:
+        json.dump({"fingerprint": fp, "host": desc,
+                   "anchored_at": int(time.time()),
+                   "metrics": {m: float(v) for m, v in metrics.items()
+                               if isinstance(v, (int, float)) and v > 0}},
+                  f, indent=2)
+        f.write("\n")
 
 
 def extract_cpu(parsed: dict | None) -> dict:
@@ -140,12 +202,15 @@ def main(argv=None) -> int:
                    help="wall budget (s) for the fresh bench.py run")
     args = p.parse_args(argv)
 
-    baseline, used = best_baseline(args.bench_dir)
-    if not baseline:
-        print("bench gate: no usable BENCH_r*.json baseline — "
-              "nothing to compare, passing")
-        return 0
-    print(f"bench gate: baseline best-of {', '.join(used)}")
+    fp, desc = host_fingerprint()
+    path = stash_path(args.bench_dir, fp)
+    stash = load_stash(path)
+    rebase = os.environ.get("GUBER_BENCH_REBASE") == "1"
+
+    legacy, used = best_baseline(args.bench_dir)
+    if legacy and used:
+        print(f"bench gate: prior rounds {', '.join(used)} "
+              "(context only — unkeyed, measured on unknown hosts)")
 
     if args.fresh_json:
         with open(args.fresh_json) as f:
@@ -165,13 +230,43 @@ def main(argv=None) -> int:
         print("bench gate BROKEN: fresh result has no CPU tier "
               f"(backend={fresh.get('backend')!r})", file=sys.stderr)
         return 2
+    gated = {m: float(fresh_cpu[m]) for m in GATED_METRICS
+             if isinstance(fresh_cpu.get(m), (int, float))
+             and fresh_cpu[m] > 0}
 
+    if rebase or not stash:
+        if not gated:
+            print("bench gate BROKEN: fresh run reported no gated metrics",
+                  file=sys.stderr)
+            return 2
+        write_stash(path, fp, desc, gated)
+        why = ("GUBER_BENCH_REBASE=1" if rebase
+               else "first run on this host")
+        print(f"bench gate: anchored baseline for {desc} "
+              f"(fp {fp}) — {why}")
+        for m, v in gated.items():
+            print(f"  {m}: {v:,.0f}")
+        return 0
+
+    baseline = stash["metrics"]
+    print(f"bench gate: baseline for {desc} (fp {fp})")
     failures = compare(baseline, fresh_cpu, args.tolerance)
     if failures:
         print("bench gate FAILED:", file=sys.stderr)
         for f_ in failures:
             print(f"  {f_}", file=sys.stderr)
+        print("  (a deliberate trade-off? re-anchor with "
+              "GUBER_BENCH_REBASE=1)", file=sys.stderr)
         return 1
+    merged = dict(baseline)
+    raised = []
+    for m, v in gated.items():
+        if v > merged.get(m, 0.0):
+            merged[m] = v
+            raised.append(m)
+    if raised:
+        write_stash(path, fp, desc, merged)
+        print(f"bench gate: baseline raised for {', '.join(raised)}")
     print("bench gate passed")
     return 0
 

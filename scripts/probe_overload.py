@@ -12,6 +12,14 @@ A healthy QoS config keeps goodput ~flat across the sweep (the extra
 offered load is shed in-band at admission, before it can queue) and the
 served p99 bounded by the drain cycle, not the backlog.
 
+The probe also boots a one-worker front door (gubernator_tpu/frontdoor.py)
+on the same instance and samples HealthCheck over real gRPC from a
+separate thread THROUGHOUT the overload sweep.  HealthCheck is answered
+worker-locally from the engine-heartbeated status block, so its RTT must
+stay flat no matter how saturated the engine loop is: the probe asserts
+healthcheck_rtt_ms_p50 < 5 ms and exits non-zero otherwise
+(--no-frontdoor skips this part).
+
 Runs in-process against a CPU Instance by default so it works anywhere:
 
     JAX_PLATFORMS=cpu python scripts/probe_overload.py
@@ -108,8 +116,69 @@ async def open_loop(inst, rps, seconds):
                 p50=pct(0.50), p99=pct(0.99))
 
 
+class HealthSampler:
+    """Dedicated-thread HealthCheck prober: a sync gRPC channel on its own
+    thread so the measured RTT is the worker's answer time, not the probe
+    event loop's scheduling backlog."""
+
+    def __init__(self, address):
+        import threading
+        self.address = address
+        self.rtts = []
+        self.errors = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        import grpc
+        from gubernator_tpu.api import pb
+        from gubernator_tpu.api.grpc_api import V1Stub
+        channel = grpc.insecure_channel(self.address)
+        stub = V1Stub(channel)
+        req = pb.HealthCheckReq()
+        while not self._stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                stub.HealthCheck(req, timeout=1.0)
+                self.rtts.append((time.perf_counter() - t0) * 1e3)
+            except Exception:
+                self.errors += 1
+            self._stop.wait(0.002)
+        channel.close()
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def p50(self):
+        if not self.rtts:
+            return float("inf")
+        return sorted(self.rtts)[len(self.rtts) // 2]
+
+    def p99(self):
+        if not self.rtts:
+            return float("inf")
+        s = sorted(self.rtts)
+        return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+
 async def amain(args):
     inst = build_instance(args)
+    hub = sampler = None
+    if not args.no_frontdoor:
+        from gubernator_tpu.config import DaemonConfig
+        from gubernator_tpu.frontdoor import FrontdoorHub
+        hub = FrontdoorHub(inst, workers=1, ring_slots=64,
+                           slab_bytes=DaemonConfig.shm_slab_bytes,
+                           listen_address="127.0.0.1:0")
+        await hub.start()
+        sampler = HealthSampler(hub.address)
+        sampler.start()
+        print(f"frontdoor worker on {hub.address}; sampling HealthCheck "
+              "through the overload sweep", flush=True)
     try:
         print("measuring closed-loop capacity...", flush=True)
         cap = await measure_capacity(inst, args.seconds)
@@ -128,7 +197,23 @@ async def amain(args):
         print(f"\npending peak {peak} (cap {args.max_pending}); "
               f"effective window "
               f"{inst.qos.congestion.effective_window() if inst.qos else '-'}")
+        if sampler is not None:
+            sampler.stop()
+            p50, p99 = sampler.p50(), sampler.p99()
+            print(f"healthcheck_rtt_ms_p50 {p50:.3f}  "
+                  f"healthcheck_rtt_ms_p99 {p99:.3f}  "
+                  f"({len(sampler.rtts)} samples, {sampler.errors} errors)")
+            if p50 >= 5.0:
+                print("FAIL: healthcheck p50 >= 5ms — the worker-local "
+                      "health path is queueing behind the engine",
+                      file=sys.stderr)
+                raise SystemExit(1)
+            print("healthcheck isolation OK (p50 < 5ms under overload)")
     finally:
+        if sampler is not None:
+            sampler.stop()
+        if hub is not None:
+            await hub.stop()
         inst.close()
 
 
@@ -144,6 +229,8 @@ def main():
     p.add_argument("--batch-per-shard", type=int, default=512)
     p.add_argument("--no-native", action="store_true",
                    help="force the Python window path (classic batcher)")
+    p.add_argument("--no-frontdoor", action="store_true",
+                   help="skip the frontdoor HealthCheck-isolation probe")
     p.add_argument("--rps-ceiling", type=float, default=50_000.0,
                    help="cap the open-loop scheduler (CPU event-loop limit)")
     asyncio.run(amain(p.parse_args()))

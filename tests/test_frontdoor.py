@@ -1,0 +1,435 @@
+"""Frontdoor differential suite: the multi-process front door vs the
+single-process oracle.
+
+The contract under test (frontdoor.py): N SO_REUSEPORT acceptor workers
+hand parsed requests to the engine over shared-memory rings, and every
+decision and response must match what the classic in-process GrpcServer
+produces for the identical stream — the engine runs LITERALLY the same
+server.py serve_* bodies either way.  The suite drives both serving modes
+against real loopback gRPC and compares:
+
+  * columnar fastpath batches (>= FASTPATH_MIN_BYTES, C-parsed in the
+    worker) and small RAW batches, both sides of the size boundary;
+  * GLOBAL-behavior streams;
+  * forwarded decisions (a frontdoor bolted onto a cluster node, keys
+    owned by the other node);
+  * worker-local sheds: draining matches the single-process admission
+    shed exactly; ring exhaustion sheds in-band with shed_reason
+    ring_full;
+  * worker crash mid-window: the submitted-but-unconsumed record dies
+    with the ring reset (no partial commit), the worker respawns on the
+    SAME public port, and counters continue exactly where they left off.
+
+workers=0 keeps the classic path (daemon boots no hub at all), asserted
+directly — that mode is byte-identical to the pre-frontdoor builds by
+construction.
+"""
+
+import asyncio
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+import gubernator_tpu  # noqa: F401
+from gubernator_tpu.api import pb
+from gubernator_tpu.api.types import (
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    Status,
+)
+from gubernator_tpu.client import AsyncClient
+from gubernator_tpu.config import DaemonConfig, EngineConfig
+from gubernator_tpu.core import shm_ring
+from gubernator_tpu.daemon import Daemon
+from gubernator_tpu.frontdoor import FrontdoorHub
+from gubernator_tpu.qos.admission import (
+    SHED_DRAINING,
+    SHED_RING_FULL,
+    shed_response,
+)
+from gubernator_tpu.server import FASTPATH_MIN_BYTES
+
+pytestmark = pytest.mark.frontdoor
+
+MINUTE = 60_000
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def run(loop, coro, timeout=120):
+    return loop.run_until_complete(asyncio.wait_for(coro, timeout=timeout))
+
+
+def _daemon_conf(workers: int, **kw) -> DaemonConfig:
+    return DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        frontdoor_workers=workers,
+        engine=EngineConfig(capacity_per_shard=2048, batch_per_shard=256),
+        **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def oracle(loop):
+    """The single-process serving mode (workers=0): today's path."""
+    d = Daemon(_daemon_conf(0))
+    run(loop, d.start())
+    yield d
+    run(loop, d.stop())
+
+
+@pytest.fixture(scope="module")
+def fd(loop):
+    """The multi-worker front door under test."""
+    d = Daemon(_daemon_conf(2))
+    run(loop, d.start())
+    yield d
+    run(loop, d.stop())
+
+
+@pytest.fixture(scope="module")
+def solo_hub(loop, oracle):
+    """A one-worker, two-slot hub bolted onto the oracle's instance: small
+    enough to exhaust the ring on demand, isolated enough to crash."""
+    hub = FrontdoorHub(oracle.instance, workers=1, ring_slots=2,
+                       slab_bytes=DaemonConfig.shm_slab_bytes,
+                       listen_address="127.0.0.1:0")
+    run(loop, hub.start())
+    yield hub
+    run(loop, hub.stop())
+
+
+def _pause_consumer(hub):
+    hub._stop_evt.set()
+    hub._consumer.join(timeout=10)
+    assert not hub._consumer.is_alive()
+
+
+def _resume_consumer(hub):
+    hub._stop_evt = threading.Event()
+    t = threading.Thread(target=hub._consume_loop,
+                         name="frontdoor-consumer", daemon=True)
+    t.start()
+    hub._consumer = t
+
+
+def req(name, key, hits=1, limit=1000, duration=MINUTE,
+        algo=Algorithm.TOKEN_BUCKET, behavior=Behavior.BATCHING):
+    return RateLimitReq(name=name, unique_key=key, hits=hits, limit=limit,
+                        duration=duration, algorithm=algo, behavior=behavior)
+
+
+def _assert_same(got, want, what):
+    """Field-exact comparison; reset_time gets slack because the two
+    daemons compute `now` seconds apart."""
+    assert len(got) == len(want), what
+    for i, (g, w) in enumerate(zip(got, want)):
+        ctx = f"{what}[{i}]"
+        assert g.status == w.status, ctx
+        assert g.limit == w.limit, ctx
+        assert g.remaining == w.remaining, ctx
+        assert g.error == w.error, ctx
+        assert g.metadata == w.metadata, ctx
+        if w.reset_time:
+            assert abs(g.reset_time - w.reset_time) < 30_000, ctx
+
+
+async def _differential(oracle, fd, batches):
+    """Send the identical stream to both daemons, item-compare every
+    response."""
+    ocl = AsyncClient(oracle.grpc.address)
+    fcl = AsyncClient(fd.frontdoor.address)
+    try:
+        for tag, batch in batches:
+            want = await ocl.get_rate_limits(batch, timeout=60)
+            got = await fcl.get_rate_limits(batch, timeout=60)
+            _assert_same(got, want, tag)
+    finally:
+        await ocl.close()
+        await fcl.close()
+
+
+def test_workers0_boots_classic_path(oracle, fd):
+    # workers=0: no hub, the classic GrpcServer — the pre-frontdoor wire
+    # path, byte-identical by construction
+    assert oracle.frontdoor is None
+    assert oracle.grpc is not None
+    # workers>0: hub only, the engine binds no public gRPC port itself
+    assert fd.frontdoor is not None
+    assert fd.grpc is None
+    assert fd.frontdoor.address
+
+
+def test_differential_cols_stream(loop, oracle, fd):
+    """The columnar fastpath lane: batches big enough for the worker-side
+    C parse, replayed over three rounds so state continuity matters."""
+    batch = [req("fd_cols", f"acct:{i:04d}") for i in range(100)]
+    size = len(pb.GetRateLimitsReq(
+        requests=[pb.req_to_pb(r) for r in batch]).SerializeToString())
+    assert size >= FASTPATH_MIN_BYTES  # really exercises the COLS lane
+    rounds = [(f"cols round {n}", batch) for n in range(3)]
+    run(loop, _differential(oracle, fd, rounds))
+
+
+def test_differential_raw_small(loop, oracle, fd):
+    """Below the fastpath floor the worker ships RAW bytes; decisions and
+    over-limit transitions must still match item-for-item."""
+    batches = [("small", [req("fd_raw", "only", limit=5)])]
+    # 7 hits against limit 3: UNDER,UNDER,UNDER,OVER... on both sides
+    batches += [(f"overlimit {n}", [req("fd_raw_over", "k", limit=3)])
+                for n in range(7)]
+    run(loop, _differential(oracle, fd, batches))
+
+
+def test_differential_fastpath_boundary(loop, oracle, fd):
+    """Both sides of FASTPATH_MIN_BYTES: the lane picked changes, the
+    answers must not."""
+    under = [req("fd_edge_u", f"k{i}") for i in range(8)]
+    over = [req("fd_edge_o", f"key:{i:05d}") for i in range(90)]
+    u = len(pb.GetRateLimitsReq(
+        requests=[pb.req_to_pb(r) for r in under]).SerializeToString())
+    o = len(pb.GetRateLimitsReq(
+        requests=[pb.req_to_pb(r) for r in over]).SerializeToString())
+    assert u < FASTPATH_MIN_BYTES <= o
+    run(loop, _differential(oracle, fd, [
+        ("under floor", under), ("over floor", over),
+        ("under again", under),
+    ]))
+
+
+def test_differential_global_behavior(loop, oracle, fd):
+    """GLOBAL-behavior streams ride the same ring; the engine's global
+    plane answers identically in both serving modes."""
+    batch = [req("fd_glob", f"g:{i}", behavior=Behavior.GLOBAL, limit=50)
+             for i in range(40)]
+    rounds = [(f"global round {n}", batch) for n in range(2)]
+    run(loop, _differential(oracle, fd, rounds))
+
+
+def test_shed_draining_matches_single_process(loop, fd):
+    """The worker's in-band draining shed must be the exact item the
+    engine's admission controller would build."""
+    hub = fd.frontdoor
+    batch = [req("fd_drain", f"d:{i}", limit=7) for i in range(5)]
+
+    async def body():
+        cl = AsyncClient(hub.address)
+        try:
+            hub.status.set_flag(shm_ring.FLAG_DRAINING, True)
+            await asyncio.sleep(0)
+            got = await cl.get_rate_limits(batch, timeout=30)
+        finally:
+            hub.status.set_flag(shm_ring.FLAG_DRAINING, False)
+            await cl.close()
+        want = [shed_response(r, SHED_DRAINING) for r in batch]
+        _assert_same(got, want, "draining shed")
+        assert all(g.status == Status.OVER_LIMIT for g in got)
+        assert all(g.metadata["shed_reason"] == SHED_DRAINING for g in got)
+
+    run(loop, body())
+
+
+def test_ring_overflow_sheds_ring_full(loop, oracle, solo_hub):
+    """Every slab in flight -> the worker sheds in-band with
+    shed_reason=ring_full instead of queueing unboundedly."""
+    hub = solo_hub
+
+    async def body():
+        cl = AsyncClient(hub.address)
+        stalls0 = hub.status.get_w(0, shm_ring.W_STALLS)
+        _pause_consumer(hub)
+        try:
+            # occupy both slots with requests the engine cannot drain yet
+            inflight = [
+                asyncio.ensure_future(cl.get_rate_limits(
+                    [req("fd_full", f"f:{i}")], timeout=60))
+                for i in range(2)
+            ]
+            deadline = time.monotonic() + 20
+            while hub.chans[0].sub_depth() < 2:
+                assert time.monotonic() < deadline, "slots never filled"
+                await asyncio.sleep(0.01)
+            shed = await cl.get_rate_limits(
+                [req("fd_full", "f:extra", limit=9)], timeout=30)
+        finally:
+            _resume_consumer(hub)
+        served = await asyncio.gather(*inflight)
+        await cl.close()
+        # the overflow answer is the in-band shed...
+        assert shed[0].status == Status.OVER_LIMIT
+        assert shed[0].remaining == 0
+        assert shed[0].metadata == {"shed": "true",
+                                    "shed_reason": SHED_RING_FULL}
+        assert hub.status.get_w(0, shm_ring.W_STALLS) > stalls0
+        # ...while the two occupying requests complete normally once the
+        # engine drains again
+        for rs in served:
+            assert rs[0].status == Status.UNDER_LIMIT
+            assert rs[0].error == ""
+
+    run(loop, body())
+
+
+def test_healthcheck_isolated_from_engine(loop, solo_hub):
+    """HealthCheck is answered worker-locally from the status block: it
+    must keep answering (fast) while the engine consumes nothing."""
+    hub = solo_hub
+
+    async def body():
+        cl = AsyncClient(hub.address)
+        hc0 = hub.status.get_w(0, shm_ring.W_HEALTHCHECKS)
+        _pause_consumer(hub)
+        try:
+            t0 = time.monotonic()
+            h = await cl.health_check(timeout=5)
+            rtt = time.monotonic() - t0
+        finally:
+            _resume_consumer(hub)
+        await cl.close()
+        assert h.status == "healthy"
+        assert rtt < 2.0  # no ring round-trip; generous for a loaded CI box
+        assert hub.status.get_w(0, shm_ring.W_HEALTHCHECKS) > hc0
+        assert hub.chans[0].sub_depth() == 0  # never touched the ring
+
+    run(loop, body())
+
+
+def test_worker_crash_no_partial_commit_then_restart(loop, oracle, solo_hub):
+    """SIGKILL the worker with a window submitted but not yet consumed:
+    the ring reset must drop it (no partial commit), the respawned worker
+    must re-claim the SAME public port, and the key's counter must
+    continue from the pre-crash value."""
+    hub = solo_hub
+
+    async def body():
+        cl = AsyncClient(hub.address)
+        for want in (9, 8):
+            rs = await cl.get_rate_limits(
+                [req("fd_crash", "victim", limit=10)], timeout=60)
+            assert rs[0].remaining == want
+
+        pid0 = hub.status.get_w(0, shm_ring.W_PID)
+        port0 = hub.port
+        served0 = hub.records_served
+        restarts0 = hub.restarts
+
+        _pause_consumer(hub)
+        # a hit lands in the submission ring and stays unconsumed...
+        doomed = asyncio.ensure_future(cl.get_rate_limits(
+            [req("fd_crash", "victim", limit=10)], timeout=60))
+        deadline = time.monotonic() + 20
+        while hub.chans[0].sub_depth() < 1:
+            assert time.monotonic() < deadline, "record never submitted"
+            await asyncio.sleep(0.01)
+        # ...when its worker dies mid-window
+        os.kill(pid0, signal.SIGKILL)
+        with pytest.raises(Exception):
+            await doomed
+        await cl.close()
+
+        # monitor notices, resets the ring (wiping the orphan record),
+        # bumps the epoch, respawns
+        deadline = time.monotonic() + 60
+        while hub.restarts == restarts0:
+            assert time.monotonic() < deadline, "worker never restarted"
+            await asyncio.sleep(0.1)
+        assert hub.chans[0].sub_depth() == 0
+        assert hub.epochs[0] >= 1
+        _resume_consumer(hub)
+        await asyncio.sleep(0.3)
+        assert hub.records_served == served0  # orphan was never served
+
+        # the respawn re-binds the same public address
+        deadline = time.monotonic() + 60
+        cl2 = AsyncClient(hub.address)
+        while True:
+            try:
+                h = await cl2.health_check(timeout=2)
+                if h.status == "healthy":
+                    break
+            except Exception:
+                pass
+            assert time.monotonic() < deadline, "respawn never came up"
+            await asyncio.sleep(0.25)
+        assert hub.status.get_w(0, shm_ring.W_PID) != pid0
+        assert hub.port == port0
+        snap = hub.debug_snapshot()
+        assert snap["restarts"] >= 1
+        assert snap["per_worker"][0]["restarts"] >= 1
+
+        # no partial commit: the killed-in-flight hit was NOT applied
+        rs = await cl2.get_rate_limits(
+            [req("fd_crash", "victim", limit=10)], timeout=60)
+        assert rs[0].remaining == 7
+        await cl2.close()
+
+    run(loop, body())
+
+
+def test_forwarded_decisions_through_frontdoor(loop):
+    """A frontdoor bolted onto one cluster node: keys owned by the OTHER
+    node forward engine-side and share state with the classic path."""
+    from gubernator_tpu import cluster as cluster_mod
+
+    async def body():
+        c = await cluster_mod.start(2)
+        hub = None
+        try:
+            hub = FrontdoorHub(c.instance_at(0), workers=1, ring_slots=8,
+                               slab_bytes=DaemonConfig.shm_slab_bytes,
+                               listen_address="127.0.0.1:0")
+            await hub.start()
+            # a key the frontdoor node does NOT own: every decision below
+            # is a forwarded round-trip to node 1
+            key = None
+            for i in range(64):
+                cand = f"peer:{i}"
+                if await c.owner_index_of("fd_fwd_" + cand) == 1:
+                    key = cand
+                    break
+            assert key is not None
+            direct = AsyncClient(c.peer_at(0))
+            fronted = AsyncClient(hub.address)
+            seq = [(direct, 3), (fronted, 2), (direct, 1), (fronted, 0)]
+            for client, want_remaining in seq:
+                rs = await client.get_rate_limits(
+                    [req("fd_fwd", key, limit=4)], timeout=60)
+                assert rs[0].status == Status.UNDER_LIMIT
+                assert rs[0].remaining == want_remaining
+                assert rs[0].error == ""
+            rs = await fronted.get_rate_limits(
+                [req("fd_fwd", key, limit=4)], timeout=60)
+            assert rs[0].status == Status.OVER_LIMIT
+            await direct.close()
+            await fronted.close()
+        finally:
+            if hub is not None:
+                await hub.stop()
+            await c.stop()
+
+    run(loop, body(), timeout=300)
+
+
+def test_frontdoor_observability_surface(loop, fd):
+    """The debug snapshot and metric families the admin plane exposes."""
+    snap = fd.frontdoor.debug_snapshot()
+    assert snap["workers"] == 2
+    assert len(snap["per_worker"]) == 2
+    assert all(r["pid"] > 0 for r in snap["per_worker"])
+    assert snap["port_mode"] in ("reuseport", "per-worker-ports")
+    text = fd.instance.metrics.expose().decode()
+    for fam in ("guber_tpu_frontdoor_workers",
+                "guber_tpu_frontdoor_rpcs_total",
+                "guber_tpu_frontdoor_restarts_total",
+                "guber_tpu_shm_ring_depth"):
+        assert fam in text, fam

@@ -41,6 +41,13 @@ NATIVE_NAMES = (
     "guber_tpu_pipeline_inflight_windows",
     "guber_tpu_pipeline_overlap_ratio",
     "guber_tpu_window_buffer_reuse_total",
+    # multi-process front door (frontdoor.py, core/shm_ring.py)
+    "guber_tpu_frontdoor_workers",
+    "guber_tpu_frontdoor_rpcs",
+    "guber_tpu_frontdoor_sheds",
+    "guber_tpu_frontdoor_restarts",
+    "guber_tpu_shm_ring_depth",
+    "guber_tpu_shm_ring_stalls",
 )
 
 
