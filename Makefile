@@ -1,7 +1,7 @@
 # Dev targets (the reference Makefile:1-15 has only release/docker; we add
 # the working set).
 
-.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos test-obs test-chaos test-analytics test-overlap test-frontdoor proto bench bench-smoke docker lint cluster
+.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos test-obs test-chaos test-analytics test-overlap test-chain test-frontdoor proto bench bench-smoke docker lint cluster
 
 test:
 	python -m pytest tests/ -x -q
@@ -60,6 +60,14 @@ test-analytics:
 test-overlap:
 	python -m pytest tests/ -x -q -m "overlap and not slow"
 
+# the deferred-fetch chain slice: stride-N stacked fetch bit-identical to
+# the depth-1 serial oracle (incl. GLOBAL interleave), whole-stride fault
+# atomicity, commit ordering under out-of-order chain fetch, adaptive
+# stride growth/shrink/deadline-bound.  Part of tier-1 (`test-core` picks
+# it up too); this target runs just the slice.
+test-chain:
+	python -m pytest tests/ -x -q -m "chain and not slow"
+
 # the multi-process front-door slice: worker-sharded serving differential
 # vs the single-process oracle (columnar + raw lanes, GLOBAL, forwarding),
 # in-band sheds (draining / ring_full), worker crash-restart with no
@@ -79,10 +87,14 @@ bench:
 # overlap probe prints the pipeline's stage split + realized overlap, and
 # a short front-door sweep (in-process baseline vs 2 acceptor workers)
 # reports e2e decisions/s + shm ring stall % through the worker path.
+# Finally the chain probe sweeps the deferred-fetch stride (raw link +
+# simulated tunnel RTT) and prints the device-tier vs serving-drain
+# reconciliation (kernel census + per-dispatch wall).
 bench-smoke:
 	python scripts/bench_compare.py
 	GUBER_PROBE_PLATFORM=cpu python scripts/probe_overlap.py
 	GUBER_PROBE_PLATFORM=cpu GUBER_PROBE_FD_WORKERS=0,2 GUBER_PROBE_SECONDS=2 python scripts/probe_frontdoor.py
+	GUBER_PROBE_PLATFORM=cpu GUBER_PROBE_B=1024 GUBER_PROBE_C=4096 GUBER_PROBE_SECONDS=1 python scripts/probe_chain.py
 
 docker:
 	docker build -t gubernator-tpu:latest .

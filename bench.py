@@ -456,6 +456,89 @@ def bench_host_sync(mesh, capacity, lanes, seconds=3.0):
     return per_sec
 
 
+def bench_chain(mesh, capacity, lanes, strides=(1, 2, 4, 8), seconds=2.0,
+                rtt_s=0.0):
+    """Deferred-fetch chain sweep: the serving drain loop (host re-stage ->
+    pipeline_dispatch -> fetch) with the blocking device_get issued every
+    Nth dispatch via ONE stacked fetch_stacked_many (the core/pipeline.py
+    chain mechanism, isolated from RPC plumbing).  Stride 1 is today's
+    fetch-every-drain serving cadence; the sweep measures what each elided
+    fetch round trip buys on THIS link (on the tunneled chip a fetch is
+    ~70ms flat, so stride N amortizes it N-fold; on CPU the fetch is cheap
+    and the gain is mostly dispatch/stage overlap).
+
+    rtt_s > 0 adds a sleep per stacked fetch modelling a link with a flat
+    per-fetch round trip (the tunnel's ~0.07s) — scripts/probe_chain.py
+    uses it to validate the stride scaling law on a CPU smoke box, where
+    the REAL fetch cost is too small to amortize.  Tier runs keep 0."""
+    import numpy as np
+
+    from gubernator_tpu.core.engine import RateLimitEngine
+    from gubernator_tpu.ops import kernel
+
+    eng = RateLimitEngine(mesh=mesh, capacity_per_shard=capacity,
+                          batch_per_shard=lanes, global_capacity=1024,
+                          global_batch_per_shard=128, max_global_updates=128)
+    rng = np.random.default_rng(11)
+    S = eng.num_local_shards
+    now = 1_700_000_200_000
+
+    # rotating slot pools; the compact encode runs per dispatch so every
+    # stride pays the SAME honest host re-staging cost
+    pools = [((rng.zipf(1.1, (S, lanes)) - 1) % capacity).astype(np.int64)
+             for _ in range(8)]
+    ones = np.ones((S, lanes), np.int64)
+    limit = np.full((S, lanes), 1_000_000, np.int64)
+    duration = np.full((S, lanes), 60_000, np.int64)
+    algo = np.zeros((S, lanes), np.int64)
+    noinit = np.zeros((S, lanes), np.int64)
+
+    def stage(i):
+        packed = kernel.encode_batch_host(
+            pools[i % 8], ones, limit, duration, algo, noinit)
+        return np.ascontiguousarray(packed[None])  # [1, S, B, 2]
+
+    for i in range(3):  # warm: compile the K=1 drain + fill the arena
+        w, _, m = eng.pipeline_dispatch(stage(i), np.full(1, now, np.int64),
+                                        n_windows=1)
+    eng.fetch_stacked_many([w, m])
+
+    sweep = {}
+    for stride in strides:
+        pending = []
+        done = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            i = done
+            w, _, m = eng.pipeline_dispatch(
+                stage(i), np.full(1, now + 10 + i, np.int64), n_windows=1)
+            pending.extend((w, m))
+            done += 1
+            if len(pending) >= 2 * stride:
+                eng.fetch_stacked_many(pending)
+                if rtt_s:
+                    time.sleep(rtt_s)
+                pending = []
+        if pending:
+            eng.fetch_stacked_many(pending)
+            if rtt_s:
+                time.sleep(rtt_s)
+        total = time.perf_counter() - t0
+        per_sec = done * lanes / total
+        sweep[stride] = per_sec
+        log(f"# chain tier: stride={stride} -> {per_sec:,.0f} decisions/s "
+            f"({done} x {lanes}-lane drains, one stacked fetch per "
+            f"{stride}"
+            + (f", +{rtt_s * 1e3:.0f}ms simulated fetch RTT)" if rtt_s
+               else ")"))
+    base = sweep.get(1, 0.0)
+    for stride in strides[1:]:
+        if base:
+            log(f"# chain tier: stride={stride} speedup vs stride-1 = "
+                f"{sweep[stride] / base:.2f}x")
+    return sweep
+
+
 def bench_bigkeys(mesh, on_cpu, seconds=5.0):
     """BASELINE eval config 5: a ~100M-key arena (2^27 slots, ~6.4GB HBM on
     the real chip) under Zipf(1.1) skew with allocation/eviction churn on a
@@ -1067,6 +1150,15 @@ def child_main():
         sync_ps = bench_host_sync(mesh, capacity, lanes,
                                   seconds=2.0 if on_cpu else 3.0)
         tier["host_sync_decisions_per_sec"] = round(sync_ps, 1)
+        checkpoint()
+
+        sweep = bench_chain(mesh, capacity, lanes,
+                            seconds=1.5 if on_cpu else 3.0)
+        tier["chain_stride_sweep"] = {str(s): round(v, 1)
+                                      for s, v in sweep.items()}
+        if sweep.get(1):
+            tier["chain_speedup_at_stride4"] = round(
+                sweep.get(4, 0.0) / sweep[1], 2)
         checkpoint()
 
         tier.update(bench_bigkeys(mesh, on_cpu,

@@ -21,6 +21,19 @@ from typing import List, Optional
 # Hard cap on items per RPC (reference gubernator.go:34).
 MAX_BATCH_SIZE = 1000
 
+# Deferred-fetch dispatch chain (core/pipeline.py) — env-only perf knobs,
+# same discipline as GUBER_PIPELINE_DEPTH.  GUBER_FETCH_STRIDE pins the
+# floor of drains that ride one stacked D2H fetch (1 = fetch every drain,
+# the classic cadence); GUBER_FETCH_STRIDE_MAX caps how far the AIMD
+# stride controller (qos/congestion.py observe_chain) may grow the chain
+# as backlog deepens; GUBER_CHAIN_LINGER_MS bounds how long a chained
+# drain waits for companions before the pipeline flushes anyway.
+# Cost model (BASELINE.md): t/window ~= (N*t_exec + t_fetch)/N — on a
+# tunneled chip whose fetch is a flat ~70ms, stride N recovers nearly N×.
+FETCH_STRIDE_DEFAULT = 1
+FETCH_STRIDE_MAX_DEFAULT = 8
+CHAIN_LINGER_MS_DEFAULT = 2.0
+
 
 @dataclass
 class BehaviorConfig:

@@ -962,6 +962,22 @@ class RateLimitEngine:
                 # without a fetch — callers with partially-filled resident
                 # stacks should pass n_decisions to keep the counter honest
                 n_decisions = k * int(np.prod(batches.slot.shape[1:]))
+        # Empty-GLOBAL skip: when this stack carries no GLOBAL lanes and
+        # the control plane is inert (every slot points one past the
+        # arena), dispatch the GLOBAL-skipping twin — same output shape,
+        # minus the per-window GLOBAL gathers/scatters/psum.  Host-staged
+        # numpy only (resident stacks are unscannable) and single-process
+        # only: in mesh mode the executable choice is part of the
+        # collective contract and must not depend on per-process staging.
+        fn = self._multi_fn
+        G = self.global_capacity
+        if (not self.multiprocess
+                and isinstance(gbatches.slot, np.ndarray)
+                and not (gbatches.slot >= 0).any()
+                and (np.asarray(upd[0]) >= G).all()
+                and (np.asarray(upd[4]) >= G).all()
+                and (np.asarray(ups[0]) >= G).all()):
+            fn = _compiled_multi_step(self.mesh, with_global=False)
         if self.multiprocess:
             batches = WindowBatch(*[self._sharded_in_stacked(np.asarray(a))
                                     for a in batches])
@@ -971,7 +987,7 @@ class RateLimitEngine:
             upd = tuple(self._repl_in(a) for a in upd)
             ups = tuple(self._repl_in(a) for a in ups)
             nows = self._repl_in(np.asarray(nows, np.int64))
-        self.state, fused, self.gstate, self.gcfg = self._multi_fn(
+        self.state, fused, self.gstate, self.gcfg = fn(
             self.state, self.gstate, self.gcfg, batches, gbatches, gaccs,
             upd, ups, nows,
         )
@@ -1116,6 +1132,29 @@ class RateLimitEngine:
         now = self._resolve_now(now)
         if k_stack is not None and k_stack > 1:
             self.step_stacked([[]], now, k_stack=k_stack)
+            if not self.multiprocess:
+                # the empty warm stack above lowers to the GLOBAL-skipping
+                # twin (step_windows inertness gate); execute the
+                # GLOBAL-carrying variant on the same inert stack too —
+                # identical to the pre-skip warmup dispatch — so the first
+                # stacked window with real GLOBAL lanes never pays a
+                # mid-serving compile
+                K = k_stack
+                SL, B = self.num_local_shards, self.batch_per_shard
+                gb, ga, upd, ups = self.empty_control()
+                stk = lambda a: np.stack([a] * K)  # noqa: E731
+                batches = WindowBatch(
+                    slot=np.full((K, SL, B), kernel.PAD_SLOT, np.int32),
+                    hits=np.zeros((K, SL, B), np.int64),
+                    limit=np.zeros((K, SL, B), np.int64),
+                    duration=np.zeros((K, SL, B), np.int64),
+                    algo=np.zeros((K, SL, B), np.int32),
+                    is_init=np.zeros((K, SL, B), bool))
+                self.state, _, self.gstate, self.gcfg = \
+                    _compiled_multi_step(self.mesh)(
+                        self.state, self.gstate, self.gcfg, batches,
+                        WindowBatch(*[stk(a) for a in gb]), stk(ga),
+                        upd, ups, np.full((K,), now, np.int64))
         # full format compiles only at full width (it is the rare fallback
         # once compact serving is up; each extra shape is a whole XLA
         # compile, which over a tunneled chip costs tens of seconds)
@@ -2683,12 +2722,13 @@ def _compiled_pipeline_step_global_impl(mesh: Mesh, pallas: bool,
     return _recursion_guarded(fn) if (pallas or fused) else fn
 
 
-def _compiled_multi_step(mesh: Mesh):
-    return _compiled_multi_step_impl(mesh, _use_pallas())
+def _compiled_multi_step(mesh: Mesh, with_global: bool = True):
+    return _compiled_multi_step_impl(mesh, _use_pallas(), with_global)
 
 
 @lru_cache(maxsize=None)
-def _compiled_multi_step_impl(mesh: Mesh, pallas: bool):
+def _compiled_multi_step_impl(mesh: Mesh, pallas: bool,
+                              with_global: bool = True):
     """K batching windows applied in ONE device dispatch via lax.scan.
 
     Each scanned iteration is a full serving window — its own timestamp, its
@@ -2703,12 +2743,25 @@ def _compiled_multi_step_impl(mesh: Mesh, pallas: bool):
     Control-plane writes (GLOBAL upserts/config, host-rare) are applied once,
     before the first window.  Stacked inputs carry a leading K dimension;
     `nows` is i64[K], one timestamp per window.
+
+    `with_global=False` compiles the GLOBAL-skipping variant: most stacked
+    dispatches carry ZERO GLOBAL lanes and inert control (every slot points
+    one past the arena), yet the composed executable still ran the whole
+    GLOBAL sub-window — gathers, scatters and a psum per scanned iteration
+    — just to produce an all-dropped output block.  Statically skipping it
+    removes those kernels per window (the round-5 calibration showed the
+    window cost is per-executed-kernel overhead); the fused output keeps
+    its [K, B+Bg, 4] shape (GLOBAL rows zero-filled) so every decode path
+    is unchanged.  step_windows picks the variant from host-staged
+    inertness, single-process only — a per-process data-dependent
+    executable choice would break the mesh collective contract.
     """
     def shard_fn(state, gstate, gcfg, batches, gbatches, gaccs, upd, ups, nows):
         # Block shapes: state [1, C]; batches [K, 1, B]; gbatches [K, 1, Bg];
         # gaccs [K, 1, Bg]; gstate/gcfg [G] replicated; nows [K].
         st = BucketState(*jax.tree.map(lambda a: a[0], state))
-        gstate, gcfg = _apply_control(gstate, gcfg, upd, ups)
+        if with_global:
+            gstate, gcfg = _apply_control(gstate, gcfg, upd, ups)
 
         def body(carry, xs):
             st, gst = carry
@@ -2716,6 +2769,13 @@ def _compiled_multi_step_impl(mesh: Mesh, pallas: bool):
             bt = WindowBatch(*jax.tree.map(lambda a: a[0], b))
             st, out = _window_step_fn(mesh, compact32=False, pallas=pallas,
                                       c32xla=False)(st, bt, now)
+            if not with_global:
+                o = jnp.stack([out.status.astype(jnp.int64), out.limit,
+                               out.remaining, out.reset_time], axis=-1)
+                Bg = gb.slot.shape[-1]
+                fused = jnp.concatenate(
+                    [o, jnp.zeros((Bg, 4), jnp.int64)], axis=0)
+                return (st, gst), fused
             gbt = WindowBatch(*jax.tree.map(lambda a: a[0], gb))
             gst, gout = _global_window(gst, gcfg, gbt, gacc[0], now, mesh, pallas)
             return (st, gst), kernel.pack_outputs(out, gout)

@@ -85,7 +85,10 @@ from gubernator_tpu.api.types import (
     RateLimitResp,
     millisecond_now,
 )
-from gubernator_tpu.config import MAX_BATCH_SIZE, env_bool, env_float, env_int
+from gubernator_tpu.config import (CHAIN_LINGER_MS_DEFAULT,
+                                   FETCH_STRIDE_DEFAULT,
+                                   FETCH_STRIDE_MAX_DEFAULT, MAX_BATCH_SIZE,
+                                   env_bool, env_float, env_int)
 from gubernator_tpu.core.engine import PIPELINE_K_BUCKETS
 from gubernator_tpu.core.window_buffers import RequestColumns, WindowArenaRing
 from gubernator_tpu.net.faults import FAULTS, SEAM_ENGINE_DISPATCH
@@ -392,7 +395,7 @@ class _DrainResult:
                  "leftover", "now", "n_decisions", "n_lanes", "k_used",
                  "error", "started", "ring_peers",
                  "pack_done", "dispatch_done", "fetch_start", "fetch_done",
-                 "oldest_enq", "arena", "cols_owner", "cfut")
+                 "oldest_enq", "arena", "cols_owner", "cfut", "deferred")
 
     def __init__(self):
         self.words = None
@@ -405,6 +408,10 @@ class _DrainResult:
         self.arena = None
         self.cols_owner = None
         self.cfut = None
+        # deferred-fetch chain member: the engine thread dispatched this
+        # drain but submitted NO fetch — the loop appends it to the chain
+        # and one stacked fetch every stride windows commits the group
+        self.deferred = False
         # traffic analytics (ops/analytics.py): the un-fetched device stats
         # array, its host copy, and whether this drain's reduction decayed
         self.stats = None
@@ -584,6 +591,39 @@ class DispatchPipeline:
         self.coalesce_wait = 0.0005
         self.coalesce_min = MAX_BATCH_SIZE  # decisions that skip the wait
         self._coalesce_handle = None
+        # Deferred-fetch dispatch chain (ROADMAP item 1): successive drains
+        # already chain on-device through the donated state carry — the
+        # blocking D2H fetch is the ONLY per-drain round trip.  With
+        # stride N the pipeline keeps up to N dispatched drains pending
+        # fetch and issues ONE stacked device_get for the whole group,
+        # committing every member in dispatch order through the same
+        # ordered completion queue (bit-identical to stride 1; see
+        # tests/test_fetch_chain.py).  GUBER_FETCH_STRIDE is the floor the
+        # operator pins (1 = fetch every drain, today's behavior);
+        # GUBER_FETCH_STRIDE_MAX caps how far the AIMD stride controller
+        # (qos/congestion.py observe_chain) may grow it as backlog
+        # deepens.  Lockstep mode never chains: the tick's collective
+        # sequence commits each drain on its own tick.
+        self.fetch_stride = max(1, env_int("GUBER_FETCH_STRIDE",
+                                           FETCH_STRIDE_DEFAULT))
+        self.fetch_stride_max = max(self.fetch_stride,
+                                    env_int("GUBER_FETCH_STRIDE_MAX",
+                                            FETCH_STRIDE_MAX_DEFAULT))
+        # linger backstop: a chained drain held behind the occupancy gate
+        # (queued work too small to dispatch) must still commit promptly
+        self.chain_linger = env_float("GUBER_CHAIN_LINGER_MS",
+                                      CHAIN_LINGER_MS_DEFAULT) / 1000.0
+        self._stride_target = 1 if self.lockstep else self.fetch_stride
+        self._chain: List[_DrainResult] = []  # loop-owned, dispatch order
+        self._chain_timer = None
+        # drains pumped but not yet through _on_dispatched: the only
+        # drains that can still JOIN the chain.  (A drain mid-fetch is in
+        # flight too but will never chain — idle decisions must not wait
+        # on it.)
+        self._predispatch = 0
+        # observability: fetches the chain elided, flush count
+        self.fetch_elided = 0
+        self.chain_flushes = 0
 
     def _spawn(self, coro) -> None:
         """create_task with a strong reference held until completion."""
@@ -631,6 +671,10 @@ class DispatchPipeline:
             "inflight_windows": self._in_flight,
             "arena_reuse_events": self._arena_ring.reuse_events,
             "arena_alloc_events": self._arena_ring.alloc_events,
+            "fetch_stride_target": self._stride_target,
+            "chained_pending": len(self._chain),
+            "fetch_elided": self.fetch_elided,
+            "chain_flushes": self.chain_flushes,
         }
 
     def install_ring(self, points, peer_of, peers, self_idx) -> None:
@@ -830,6 +874,11 @@ class DispatchPipeline:
             return  # drains happen only on the cluster tick (lockstep_pump)
         depth = (self.depth if self.qos is None
                  else self.qos.congestion.effective_depth(self.depth))
+        stride = self._stride_target = self._stride_current()
+        if stride > 1:
+            # the chain needs stride drains pending fetch PLUS one being
+            # packed/dispatched, or it could never reach its stride
+            depth = max(depth, stride + 1)
         if self._closed or self._in_flight >= depth:
             return
         if self.gate_enabled and self._in_flight >= 1 and self.gate_frac > 0:
@@ -866,8 +915,15 @@ class DispatchPipeline:
         jobs, cols = self._take_jobs()
         if not jobs:
             self._cols_release(cols)
+            if self._chain and self._predispatch == 0:
+                # nothing queued and nothing still heading for dispatch:
+                # no drain can join the chain anymore, so holding it only
+                # adds latency (e.g. a prior unchained drain just
+                # committed and re-pumped an empty queue)
+                self._chain_flush()
             return
         self._note_inflight(1)
+        self._predispatch += 1
         fut = self._loop.run_in_executor(self._engine_executor,
                                          self._drain_sync, jobs, None, None,
                                          None, cols)
@@ -876,6 +932,143 @@ class DispatchPipeline:
     def _coalesce_fire(self) -> None:
         self._coalesce_handle = None
         self._pump(force=True)
+
+    # ------------------------------------------------------------ fetch chain
+
+    def _stride_current(self) -> int:
+        """Drains per stacked fetch the chain should target right now
+        (loop thread; the engine thread reads the cached _stride_target).
+        Floor = the operator-pinned GUBER_FETCH_STRIDE; the AIMD stride
+        controller may grow it with backlog up to GUBER_FETCH_STRIDE_MAX,
+        but never past the admission deadline bound — a chained drain's
+        oldest member must still commit inside the propagated deadline,
+        so thundering-herd p99 stays bounded instead of scaling with the
+        chain."""
+        if self.lockstep:
+            return 1
+        if self.fetch_stride_max <= 1 or self.qos is None:
+            return min(self.fetch_stride, self.fetch_stride_max)
+        cc = self.qos.congestion
+        stride = max(self.fetch_stride, cc.effective_stride())
+        bound = cc.stride_bound(self.qos.conf.default_deadline)
+        return max(1, min(stride, self.fetch_stride_max, bound))
+
+    def _backlog_windows(self) -> float:
+        """Queued decisions behind the pipeline, in window units (loop
+        thread) — the stride controller's growth signal."""
+        fold = (self.decisions_staged / self.lanes_staged
+                if self.lanes_staged > MAX_BATCH_SIZE else 1.0)
+        pending = (len(self._singles)
+                   + sum(len(j.data) // 16 if isinstance(j, RpcJob)
+                         else j.n for j in self._jobs))
+        eng = self.engine
+        lanes = eng.batch_per_shard * eng.num_local_shards
+        return (pending / max(fold, 1.0)) / max(lanes, 1)
+
+    def _chain_add(self, res: _DrainResult) -> None:
+        """Append a dispatched-but-unfetched drain to the chain (loop
+        thread).  Flush when the stride is reached, or when nothing else
+        is coming — an empty queue with no drain still heading for
+        dispatch means waiting only adds latency, so light load
+        degenerates to stride 1 (the depth-1 oracle's cadence).  Work
+        held back by the occupancy gate re-arms the linger timer as the
+        backstop: a chained commit is never more than chain_linger late."""
+        self._chain.append(res)
+        if self.metrics is not None:
+            self.metrics.chain_inflight_windows.set(len(self._chain))
+        idle = (not self._jobs and not self._singles
+                and self._predispatch == 0)
+        if len(self._chain) >= self._stride_target or idle or self._closed:
+            self._chain_flush()
+        elif self._chain_timer is None:
+            self._chain_timer = self._loop.call_later(
+                self.chain_linger, self._chain_flush)
+
+    def _chain_flush(self) -> None:
+        """Issue ONE stacked fetch for every chained drain (loop thread).
+        The group commits in dispatch order — the chain list preserves
+        it, and _on_chain_completed walks it front to back through the
+        same ordered completion queue as unchained drains."""
+        if self._chain_timer is not None:
+            self._chain_timer.cancel()
+            self._chain_timer = None
+        if not self._chain:
+            return
+        group, self._chain = self._chain, []
+        self.chain_flushes += 1
+        self.fetch_elided += len(group) - 1
+        if self.metrics is not None:
+            m = self.metrics
+            m.chain_inflight_windows.set(0)
+            m.chain_fetch_stride.set(self._stride_target)
+            if len(group) > 1:
+                m.chain_fetch_elided.inc(len(group) - 1)
+        if self.qos is not None:
+            self.qos.congestion.observe_chain(self._backlog_windows(),
+                                              self.fetch_stride_max)
+        cfut = self._loop.run_in_executor(self._fetch_executor,
+                                          self._complete_chain_sync, group)
+        cfut.add_done_callback(lambda f: self._on_chain_completed(f, group))
+
+    def _complete_chain_sync(self, group: List[_DrainResult]) -> list:
+        """Fetch thread: ONE device_get materializes every chained
+        drain's response words and mismatch planes (engine
+        fetch_stacked_many), then each member demuxes in dispatch order.
+        The members' device time already overlapped at dispatch (donated
+        state chains them on-device); this collapses their N fetch round
+        trips — the serving path's fixed ~70ms cost each over the
+        tunnel — into one."""
+        t0 = time.monotonic()
+        eng = self.engine
+        B = eng.batch_per_shard
+        arrs: List[object] = []
+        for res in group:
+            if res.words is not None:
+                arrs.extend((res.words, res.mism))
+        fetched = iter(eng.fetch_stacked_many(arrs) if arrs else ())
+        pairs = []
+        for res in group:
+            res.fetch_start = t0
+            if res.words is None:  # all-forwarded member: nothing local
+                wflat = np.empty((0, B), np.int64)
+                clflat = None
+            else:
+                words = np.ascontiguousarray(next(fetched))
+                mism = next(fetched)
+                clflat = None
+                if mism.any():
+                    clflat = np.ascontiguousarray(
+                        eng._fetch_local_stacked(res.limits)).reshape(-1, B)
+                wflat = words.reshape(-1, B)
+            if res.stats is not None:
+                # same contract as _complete_sync: analytics must never
+                # fail a drain, so its fetch stays separately guarded
+                # (the async copy landed long ago — this is near-free)
+                try:
+                    res.stats_host = eng._fetch_local(res.stats)
+                except Exception:
+                    log.exception("analytics stats fetch failed")
+            outs = [job.finish(self, wflat, clflat, res.now)
+                    for job in res.staged]
+            res.fetch_done = time.monotonic()
+            pairs.append((res, outs))
+        return pairs
+
+    def _on_chain_completed(self, fut, group: List[_DrainResult]) -> None:
+        """Loop thread: commit every chained member in dispatch order
+        through the same completion path as an unchained drain.  A failed
+        group fetch fails EVERY member's jobs — one stacked fetch means
+        one failure domain, and none of the members' arenas can prove the
+        device finished with them (all dropped)."""
+        try:
+            pairs = fut.result()
+        except Exception as e:
+            log.exception("pipeline chain fetch failed")
+            for res in group:
+                self._fail_completed(res, e)
+            return
+        for res, outs in pairs:
+            self._commit_completed(res, outs)
 
     def _take_global_job(self) -> Optional[_GlobalJob]:
         """Snapshot the queued GLOBAL singles into one _GlobalJob for this
@@ -923,6 +1116,7 @@ class DispatchPipeline:
         gjob = self._take_global_job() if not self._closed else None
         all_jobs = jobs + ([gjob] if gjob is not None else [])
         self._note_inflight(1)
+        self._predispatch += 1
         fut = self._loop.run_in_executor(
             self._engine_executor,
             lambda: self._drain_sync(jobs, now=now, k_fixed=k_stack,
@@ -931,6 +1125,7 @@ class DispatchPipeline:
         return fut
 
     def _on_dispatched(self, fut, jobs) -> None:
+        self._predispatch -= 1
         try:
             res: _DrainResult = fut.result()
         except Exception as e:  # drain itself crashed (bug): fail ITS jobs
@@ -938,6 +1133,7 @@ class DispatchPipeline:
             self._note_inflight(-1)
             for job in jobs:
                 self._resolve_error(job, e)
+            self._chain_flush()
             self._pump(force=True)
             return
         # fallback jobs re-route outside the pipeline
@@ -959,6 +1155,9 @@ class DispatchPipeline:
             self._cols_release(res.cols_owner)
             for job in res.staged:
                 self._resolve_error(job, res.error)
+            # a dispatch fault breaks the chain's cadence: commit the
+            # members already in flight now instead of lingering
+            self._chain_flush()
             self._pump(force=True)
             return
         if not res.staged:
@@ -982,6 +1181,14 @@ class DispatchPipeline:
                  if isinstance(j, RpcJob) and len(j.remote_idx)]
         if mixed:
             self._spawn_forwards(mixed, res.ring_peers)
+        if res.deferred:
+            # deferred-fetch chain: no fetch was submitted for this drain —
+            # it joins the chain and ONE stacked fetch commits the whole
+            # group every stride windows.  Forwards (above) were spawned
+            # first, so a mixed member's splice finds its forward_task.
+            self._chain_add(res)
+            self._pump(force=True)
+            return
         if res.cfut is not None:
             # fetch was already submitted from the engine thread at the end
             # of the drain (hop cut: no event-loop round trip between
@@ -1069,23 +1276,34 @@ class DispatchPipeline:
                     one_chunk(owner_idx, items[base:base + MAX_BATCH_SIZE]))
 
     def _on_completed(self, fut, res: _DrainResult) -> None:
-        self._note_inflight(-1)
-        self._cols_release(res.cols_owner)
-        res.cols_owner = None
         try:
             _, outs = fut.result()
         except Exception as e:  # fetch/demux failed: fail THIS drain's jobs
             log.exception("pipeline fetch failed")
-            # the arena is NOT released: a failed fetch gives no proof the
-            # device finished reading its buffers, so the ring self-heals
-            # by allocating a replacement later
-            res.arena = None
-            if self.slo is not None:  # availability evidence: errored work
-                self.slo.observe_error(max(1, res.n_decisions))
-            for job in res.staged:
-                self._resolve_error(job, e)
-            self._pump(force=True)
+            self._fail_completed(res, e)
             return
+        self._commit_completed(res, outs)
+
+    def _fail_completed(self, res: _DrainResult, err: Exception) -> None:
+        """Completion-path failure (loop thread): fail the drain's jobs.
+        Shared by the single-drain and chained fetch paths."""
+        self._note_inflight(-1)
+        self._cols_release(res.cols_owner)
+        res.cols_owner = None
+        # the arena is NOT released: a failed fetch gives no proof the
+        # device finished reading its buffers, so the ring self-heals
+        # by allocating a replacement later
+        res.arena = None
+        if self.slo is not None:  # availability evidence: errored work
+            self.slo.observe_error(max(1, res.n_decisions))
+        for job in res.staged:
+            self._resolve_error(job, err)
+        self._pump(force=True)
+
+    def _commit_completed(self, res: _DrainResult, outs) -> None:
+        self._note_inflight(-1)
+        self._cols_release(res.cols_owner)
+        res.cols_owner = None
         # CLEAN completion: the fetch materialized the drain's outputs, so
         # the device provably consumed the staged stack — the arena may be
         # recycled for a future drain
@@ -1548,6 +1766,14 @@ class DispatchPipeline:
         res.n_lanes = int(fills.sum())
         self.decisions_staged += res.n_decisions
         self.lanes_staged += res.n_lanes
+        # deferred-fetch chain: with a stride target above 1 this drain
+        # submits NO fetch at all — the loop appends it to the chain and
+        # one stacked fetch commits the whole group (the stride target is
+        # a plain int the loop refreshes every pump; a stale read here
+        # only shifts WHERE the fetch is submitted, never correctness).
+        if res.staged and self._stride_target > 1 and not self.lockstep:
+            res.deferred = True
+            return res
         # hop cut: submit the fetch from HERE (engine thread) instead of
         # bouncing through the event loop first — the fetch worker starts
         # the blocking device read one loop-latency earlier.  Mixed RPCs
@@ -1675,4 +1901,8 @@ class DispatchPipeline:
         for _, f in gsingles:
             if not f.done():
                 f.set_exception(err)
+        # chained drains still pending fetch commit NOW: the flush submits
+        # before shutdown, and shutdown(wait=False) still runs work that
+        # was already queued
+        self._chain_flush()
         self._fetch_executor.shutdown(wait=False)

@@ -161,6 +161,32 @@ class Instance:
 
     # ------------------------------------------------------------ public API
 
+    def add_to_server(self, server, *, v1: bool = True,
+                      peers: bool = True) -> None:
+        """Embed this instance's gRPC services onto a CALLER-OWNED
+        grpc.aio.Server (the reference's GRPCServers embedding hook,
+        config.go:30-31): the caller keeps ownership of the server's
+        lifecycle, ports, interceptors and TLS; this just registers the
+        pb.gubernator.V1 and/or pb.gubernator.PeersV1 handlers backed by
+        this instance.
+
+        `v1`/`peers` select which service to mount — one process can host
+        two instances on ONE server by splitting the services between them
+        (front-door V1 on one engine, peer traffic on another).  gRPC
+        generic handlers match in registration order, so mounting the SAME
+        service from two instances leaves the first registration serving
+        all of its RPCs.
+        """
+        # deferred import: server.py imports Instance from this module
+        from gubernator_tpu.api.grpc_api import (add_peers_servicer,
+                                                 add_v1_servicer)
+        from gubernator_tpu.server import _PeersServicer, _V1Servicer
+
+        if v1:
+            add_v1_servicer(server, _V1Servicer(self))
+        if peers:
+            add_peers_servicer(server, _PeersServicer(self))
+
     async def get_rate_limits(
         self, requests: Sequence[RateLimitReq],
         deadline: Optional[float] = None,

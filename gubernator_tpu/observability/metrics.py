@@ -191,6 +191,28 @@ class Metrics:
             ["event"],  # reuse | alloc
             registry=self.registry,
         )
+        # deferred-fetch dispatch chain (core/pipeline.py): the adaptive
+        # stride (drains per stacked D2H fetch), how many dispatched
+        # drains currently await the chain's shared fetch, and the fetch
+        # round trips the chain has elided altogether
+        self.chain_fetch_stride = Gauge(
+            "guber_tpu_chain_fetch_stride",
+            "Current deferred-fetch chain stride (drains per stacked "
+            "fetch; 1 = fetch every drain).",
+            registry=self.registry,
+        )
+        self.chain_inflight_windows = Gauge(
+            "guber_tpu_chain_inflight_windows",
+            "Dispatched drains currently chained awaiting the shared "
+            "stacked fetch.",
+            registry=self.registry,
+        )
+        self.chain_fetch_elided = Counter(
+            "guber_tpu_chain_fetch_elided_total",
+            "Device-to-host fetch round trips elided by chaining drains "
+            "behind one stacked fetch.",
+            registry=self.registry,
+        )
         # state lifecycle (state/snapshot.py, state/migrate.py): the slot
         # occupancy gauges come from engine.cache_stats at scrape time
         self.cache_slots = Gauge(

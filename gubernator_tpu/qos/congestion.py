@@ -51,6 +51,15 @@ class CongestionController:
                            "fetch_decode": 0.0}
         self._stages_observed = False
         self._pipelined = False
+        # deferred-fetch chain stride (core/pipeline.py): how many drains
+        # ride one stacked D2H fetch.  Same AIMD shape as cwnd but a
+        # SEPARATE state variable: stride trades per-drain latency for
+        # fetch amortization, so it grows only while backlog is deep AND
+        # latency still holds, and collapses toward 1 the moment either
+        # signal flips.
+        self._stride = 1.0
+        self.stride_increases = 0
+        self.stride_decreases = 0
 
     # ------------------------------------------------------------- signal
 
@@ -103,6 +112,25 @@ class CongestionController:
                 self.stage_ewma[k] += a * (v - self.stage_ewma[k])
         self._pipelined = bool(pipelined)
 
+    def observe_chain(self, backlog_windows: float, cap: int) -> None:
+        """Adapt the deferred-fetch stride from one chain flush: additive
+        increase while at least one more window's worth of work is queued
+        behind the chain and drain latency holds under target; otherwise
+        multiplicative decrease toward 1 (fetch every drain — no added
+        latency under light load).  `cap` is the pipeline's configured
+        GUBER_FETCH_STRIDE_MAX ceiling."""
+        if backlog_windows >= 1.0 and not self.congested:
+            if self._stride < cap:
+                # unit additive step (NOT aimd_increase, which is sized in
+                # decisions-per-window units): stride is a small integer,
+                # so probing one extra chained drain per flush is the
+                # gentlest useful growth
+                self._stride = min(float(cap), self._stride + 1.0)
+                self.stride_increases += 1
+        elif self._stride > 1.0:
+            self._stride = max(1.0, self._stride * self.decrease)
+            self.stride_decreases += 1
+
     # ------------------------------------------------------------- policy
 
     def effective_window(self) -> int:
@@ -118,6 +146,24 @@ class CongestionController:
             return max_depth
         frac = self._cwnd / float(self.max_window)
         return max(1, min(max_depth, round(max_depth * frac)))
+
+    def effective_stride(self) -> int:
+        """Drains per stacked fetch the chain should currently target."""
+        return max(1, int(self._stride))
+
+    def stride_bound(self, latency_budget: float) -> int:
+        """Admission-deadline cap on the chain depth: the oldest chained
+        drain waits ~(stride-1) dispatch cadences plus the shared fetch
+        before it commits, so the deepest stride whose head still meets
+        `latency_budget` (seconds) is (budget - t_fetch) / t_exec at the
+        observed stage EWMAs.  Unbounded (a huge int) while the budget is
+        unset or the stages are unobserved — a fresh node has no evidence
+        to cap on, and the configured GUBER_FETCH_STRIDE_MAX still rules."""
+        if latency_budget <= 0 or not self._stages_observed:
+            return 1 << 30
+        exec_s = max(self.stage_ewma["device_dispatch"], 1e-6)
+        fetch_s = self.stage_ewma["fetch_decode"]
+        return max(1, int((latency_budget - fetch_s) / exec_s))
 
     def drain_cycle_estimate(self) -> float:
         """Expected wall time of one drain cycle, for the admission wait

@@ -1,0 +1,203 @@
+"""Probe: reconcile the bench device tier vs the serving drain, and sweep
+the deferred-fetch chain stride (core/pipeline.py).
+
+The round-4 bench left a ~1000x gap on the books: the device tier reports
+1.2-1.6 B decisions/s while pipelined serving tops out near 1.86 M/s on
+the same chip.  This probe runs both executables side by side and counts
+what each dispatch actually executes and waits for:
+
+  * kernel census (ops/pallas_kernel.kernel_census) of the device-tier
+    executable (_compiled_multi_step: K windows + GLOBAL sub-window per
+    dispatch, resident inputs) vs the serving stacked drain
+    (_compiled_pipeline_step: compact decode -> window -> compact encode)
+  * per-dispatch wall time of each loop — the device tier chains donated
+    state across ALL iterations and fetches ONCE at the end; the serving
+    loop re-stages numpy on the host and eats a blocking fetch per drain
+  * the chain stride sweep (bench.bench_chain): fetch every Nth drain via
+    one stacked device_get — raw, and with a simulated flat per-fetch RTT
+    (GUBER_PROBE_RTT_MS, default 70 = the measured tunnel fetch cost),
+    which is the regime the chain is built for
+
+Standalone (CPU smoke):
+
+    GUBER_PROBE_PLATFORM=cpu python scripts/probe_chain.py
+    ... --write-notes   # append the reconciliation to BENCH_NOTES.md
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts._probe_env import setup as _setup  # noqa: E402
+_setup()
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+B = int(os.environ.get("GUBER_PROBE_B", "4096"))
+CAP = int(os.environ.get("GUBER_PROBE_C", str(1 << 16)))
+K = int(os.environ.get("GUBER_PROBE_K", "8"))
+ITERS = int(os.environ.get("GUBER_PROBE_ITERS", "20"))
+SECONDS = float(os.environ.get("GUBER_PROBE_SECONDS", "1.5"))
+RTT_MS = float(os.environ.get("GUBER_PROBE_RTT_MS", "70"))
+NOW = 1_700_000_000_000
+
+
+def eprint(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    import bench
+    from gubernator_tpu.core import engine as eng_mod
+    from gubernator_tpu.core.engine import RateLimitEngine
+    from gubernator_tpu.ops import kernel
+    from gubernator_tpu.ops import pallas_kernel as pk
+    from gubernator_tpu.parallel.mesh import make_mesh
+
+    devs = jax.devices()
+    eprint(f"# backend: {devs[0].platform} ({devs[0].device_kind})")
+    mesh = make_mesh(devs[:1])
+    rng = np.random.default_rng(3)
+
+    eng = RateLimitEngine(mesh=mesh, capacity_per_shard=CAP,
+                          batch_per_shard=B, global_capacity=64,
+                          global_batch_per_shard=8, max_global_updates=8)
+    S = eng.num_local_shards
+
+    # ---- executable census: what ONE dispatch of each tier executes
+    def stack_batches(k):
+        slots = ((rng.zipf(1.1, (k, S, B)) - 1) % CAP).astype(np.int32)
+        return kernel.WindowBatch(
+            slot=slots, hits=np.ones((k, S, B), np.int64),
+            limit=np.full((k, S, B), 1_000_000, np.int64),
+            duration=np.full((k, S, B), 60_000, np.int64),
+            algo=np.zeros((k, S, B), np.int32),
+            is_init=np.zeros((k, S, B), bool))
+
+    gb, ga, upd, ups = eng.empty_control()
+    stk = lambda a: np.stack([a] * K)  # noqa: E731
+    dev_args = (eng.state, eng.gstate, eng.gcfg, stack_batches(K),
+                kernel.WindowBatch(*[stk(a) for a in gb]), stk(ga),
+                upd, ups, np.full(K, NOW, np.int64))
+    dev_census = pk.kernel_census(
+        jax.make_jaxpr(eng_mod._compiled_multi_step(mesh))(*dev_args))
+
+    slots = ((rng.zipf(1.1, (S, B)) - 1) % CAP).astype(np.int64)
+    packed = kernel.encode_batch_host(
+        slots, np.ones((S, B), np.int64),
+        np.full((S, B), 1_000_000, np.int64),
+        np.full((S, B), 60_000, np.int64),
+        np.zeros((S, B), np.int64), np.zeros((S, B), np.int64))[None]
+    serve_census = pk.kernel_census(jax.make_jaxpr(
+        eng_mod._compiled_pipeline_step(mesh))(
+        eng.state, packed, np.full(1, NOW, np.int64)))
+    eprint(f"# census: device tier {dev_census} kernels / {K}-window "
+           f"dispatch ({dev_census / K:.1f}/window); serving drain "
+           f"{serve_census} kernels / 1-window dispatch")
+
+    # ---- per-dispatch wall: device tier (resident, chained, ONE fetch)
+    dstack = jax.device_put(stack_batches(K))
+    dgb = jax.device_put(kernel.WindowBatch(*[stk(a) for a in gb]))
+    dga = jax.device_put(stk(ga))
+    dupd = jax.device_put(upd)
+    dups = jax.device_put(ups)
+    out = None
+    for i in range(3):
+        out = eng.step_windows(dstack, dgb, dga, dupd, dups,
+                               np.full(K, NOW + i * K, np.int64),
+                               compact_safe=True, n_decisions=K * B)
+    np.asarray(out)
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        out = eng.step_windows(dstack, dgb, dga, dupd, dups,
+                               np.full(K, NOW + (9 + i) * K, np.int64),
+                               compact_safe=True, n_decisions=K * B)
+    np.asarray(out)  # donated-state chain: ONE fetch syncs everything
+    dev_total = time.perf_counter() - t0
+    dev_ps = ITERS * K * B / dev_total
+    dev_ms = dev_total / ITERS * 1e3
+    eprint(f"# device tier: {ITERS} x {K}-window dispatches, "
+           f"{dev_ms:.2f} ms/dispatch, {dev_ps:,.0f} decisions/s "
+           f"(resident inputs, 1 fetch total)")
+
+    # ---- per-dispatch wall: serving loop at stride 1 (stage+fetch each)
+    for i in range(3):
+        w, _, m = eng.pipeline_dispatch(packed, np.full(1, NOW, np.int64),
+                                        n_windows=1)
+    eng.fetch_stacked_many([w, m])
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        pk_i = kernel.encode_batch_host(
+            slots, np.ones((S, B), np.int64),
+            np.full((S, B), 1_000_000, np.int64),
+            np.full((S, B), 60_000, np.int64),
+            np.zeros((S, B), np.int64), np.zeros((S, B), np.int64))[None]
+        w, _, m = eng.pipeline_dispatch(
+            pk_i, np.full(1, NOW + 100 + i, np.int64), n_windows=1)
+        eng.fetch_stacked_many([w, m])
+    serve_total = time.perf_counter() - t0
+    serve_ps = ITERS * B / serve_total
+    serve_ms = serve_total / ITERS * 1e3
+    eprint(f"# serving drain (stride 1): {ITERS} x 1-window dispatches, "
+           f"{serve_ms:.2f} ms/dispatch, {serve_ps:,.0f} decisions/s "
+           f"(host re-stage + blocking fetch each)")
+
+    # ---- stride sweep: raw link, then the flat-RTT regime
+    eprint("# stride sweep (raw link):")
+    raw = bench.bench_chain(mesh, CAP, B, seconds=SECONDS)
+    eprint(f"# stride sweep (+{RTT_MS:.0f}ms simulated per-fetch RTT, "
+           f"the tunnel's measured flat fetch cost):")
+    sim = bench.bench_chain(mesh, CAP, B, seconds=SECONDS,
+                            rtt_s=RTT_MS / 1e3)
+
+    para = (
+        "Chain reconciliation (scripts/probe_chain.py, backend "
+        f"{devs[0].platform}, {B} lanes, 2^{CAP.bit_length() - 1} arena): "
+        "the bench device tier and the serving drain run DIFFERENT "
+        "executables and, more importantly, different fetch cadences.  "
+        f"One device-tier dispatch executes {dev_census} kernels for {K} "
+        f"windows ({dev_census / K:.1f}/window, GLOBAL sub-window "
+        "included) over resident device inputs, chains every dispatch "
+        "through the donated state, and pays ONE fetch for the whole "
+        f"run — measured here at {dev_ms:.2f} ms/dispatch = "
+        f"{dev_ps:,.0f} decisions/s.  One serving drain executes "
+        f"{serve_census} kernels (compact decode -> window -> compact "
+        "encode), but re-stages its window from numpy on the host and "
+        "blocks on a device_get EVERY drain — measured at "
+        f"{serve_ms:.2f} ms/dispatch = {serve_ps:,.0f} decisions/s.  "
+        "The per-window kernel counts are comparable; the gap is the "
+        "per-drain fetch plus host staging, which on the tunneled chip "
+        "is a flat ~70 ms regardless of size — that alone caps stride-1 "
+        "serving at lanes/0.07s (~0.5 M/s at 32k lanes) while the "
+        "device tier's amortized fetch leaves it bounded by kernel "
+        "execution, hence the ~1000x book gap (1.2-1.6 B/s vs ~1.86 "
+        "M/s).  The deferred-fetch chain moves serving toward the "
+        "device tier's cadence: t/window ~= (N*t_exec + t_fetch)/N.  "
+        "On this box's raw link (fetch ~free) the sweep gives "
+        + ", ".join(f"stride {s}: {v / 1e6:.2f} M/s"
+                    for s, v in raw.items())
+        + (f"; with the {RTT_MS:.0f} ms flat per-fetch RTT the tunnel "
+           "actually charges, "
+           + ", ".join(f"stride {s}: {v / 1e3:.0f} k/s"
+                       for s, v in sim.items())
+           + f" — {sim[4] / sim[1]:.1f}x at stride 4, "
+           f"{sim[8] / sim[1]:.1f}x at stride 8, tracking the cost "
+           "model's N-fold fetch amortization.")
+    )
+    print(para, flush=True)
+
+    if "--write-notes" in sys.argv:
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "BENCH_NOTES.md")
+        stamp = time.strftime("%Y-%m-%d")
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write(f"\n## Chain reconciliation ({stamp})\n\n{para}\n")
+        eprint(f"# appended reconciliation to {path}")
+
+
+if __name__ == "__main__":
+    main()

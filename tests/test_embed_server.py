@@ -1,0 +1,101 @@
+"""Instance.add_to_server: embedding gubernator onto a CALLER-OWNED
+grpc.aio.Server (the reference's GRPCServers hook, config.go:30-31).
+
+The caller keeps the server's lifecycle, port and interceptors; the hook
+only registers the pb.gubernator.V1 / pb.gubernator.PeersV1 handlers.
+Two instances share ONE server by splitting the services between them —
+front-door V1 on one engine, the peer plane on another — and each RPC
+must land on the instance that mounted its service.
+"""
+
+import asyncio
+
+import grpc
+import pytest
+
+import gubernator_tpu  # noqa: F401
+from gubernator_tpu.api import pb
+from gubernator_tpu.api.grpc_api import PeersV1Stub, V1Stub
+from gubernator_tpu.config import Config, EngineConfig
+from gubernator_tpu.core.service import Instance
+
+
+def _conf():
+    return Config(engine=EngineConfig(
+        capacity_per_shard=256, batch_per_shard=64,
+        global_capacity=16, global_batch_per_shard=8,
+        max_global_updates=8))
+
+
+def _req(key):
+    return pb.RateLimitReq(name="embed", unique_key=key, hits=1,
+                           limit=10, duration=60_000)
+
+
+def test_two_instances_one_server():
+    async def body():
+        front = Instance(_conf())   # mounts V1 only
+        peer = Instance(_conf())    # mounts PeersV1 only
+        server = grpc.aio.server()
+        front.add_to_server(server, peers=False)
+        peer.add_to_server(server, v1=False)
+        port = server.add_insecure_port("127.0.0.1:0")
+        await server.start()
+        try:
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                v1 = V1Stub(ch)
+                peers = PeersV1Stub(ch)
+
+                got = await v1.GetRateLimits(
+                    pb.GetRateLimitsReq(requests=[_req("a")]))
+                assert got.responses[0].remaining == 9
+                h = await v1.HealthCheck(pb.HealthCheckReq())
+                assert h.status == "healthy"
+
+                got = await peers.GetPeerRateLimits(
+                    pb.GetPeerRateLimitsReq(requests=[_req("a")]))
+                # the PEER instance owns a separate engine: key "a" is
+                # fresh there, so its decrement starts from its own limit
+                assert got.rate_limits[0].remaining == 9
+
+                # routing proof: V1 traffic only touched `front`'s engine,
+                # peer traffic only touched `peer`'s
+                assert front.engine.decisions_processed >= 1
+                assert peer.engine.decisions_processed >= 1
+                before = (front.engine.decisions_processed,
+                          peer.engine.decisions_processed)
+                await v1.GetRateLimits(
+                    pb.GetRateLimitsReq(requests=[_req("b")]))
+                assert front.engine.decisions_processed > before[0]
+                assert peer.engine.decisions_processed == before[1]
+        finally:
+            await server.stop(0)
+            await front.aclose()
+            await peer.aclose()
+
+    asyncio.run(asyncio.wait_for(body(), timeout=120))
+
+
+def test_add_to_server_full_mount_serves_both_planes():
+    """Default mount (both services) on a caller-owned server: one
+    instance answers both the public and the peer plane."""
+    async def body():
+        inst = Instance(_conf())
+        server = grpc.aio.server()
+        inst.add_to_server(server)
+        port = server.add_insecure_port("127.0.0.1:0")
+        await server.start()
+        try:
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                got = await V1Stub(ch).GetRateLimits(
+                    pb.GetRateLimitsReq(requests=[_req("x")]))
+                assert got.responses[0].remaining == 9
+                got = await PeersV1Stub(ch).GetPeerRateLimits(
+                    pb.GetPeerRateLimitsReq(requests=[_req("x")]))
+                # same engine now: the second hit on "x" continues draining
+                assert got.rate_limits[0].remaining == 8
+        finally:
+            await server.stop(0)
+            await inst.aclose()
+
+    asyncio.run(asyncio.wait_for(body(), timeout=120))

@@ -1,0 +1,442 @@
+"""Deferred-fetch dispatch chains: differential suite vs the depth-1
+serial oracle.
+
+The tentpole contract (core/pipeline.py fetch chain): with a fetch
+stride N > 1 the pipeline keeps up to N donated-state dispatches in
+flight as a chain — window K+1's dispatch consumes window K's un-fetched
+device outputs as state carry — and issues ONE stacked device_get for
+the whole group, decoding every member in dispatch order through the
+same ordered completion queue.  Because per-key state is committed at
+dispatch (single engine thread, FIFO) and the chain only defers the
+HOST-side fetch, every decision must stay BIT-IDENTICAL to fetching
+after every drain.  This suite pins that:
+
+  * stride 1/2/8 match the serial oracle over multi-window bursts
+  * GLOBAL singles interleaved mid-chain change nothing
+  * an injected `engine_dispatch` fault mid-chain fails the faulted
+    drain whole (no partial commit — the C router staging is aborted)
+    and flushes the chained members immediately; ALREADY-DISPATCHED
+    members stand, because their donated device state advanced at
+    dispatch and cannot be un-committed
+  * a failed stacked fetch fails EVERY chained member (one fetch, one
+    failure domain) and the pipeline recovers
+  * the AIMD stride controller grows under backlog and collapses toward
+    1 under light load / congestion, bounded by the admission deadline
+  * commit ordering holds when a later chain's fetch completes first
+"""
+
+import asyncio
+import time
+import types
+
+import numpy as np
+import pytest
+
+import gubernator_tpu  # noqa: F401
+from gubernator_tpu import native
+from gubernator_tpu.api.types import Algorithm, Behavior, RateLimitReq
+from gubernator_tpu.config import BehaviorConfig, QoSConfig
+from gubernator_tpu.core.batcher import WindowBatcher
+from gubernator_tpu.core.engine import RateLimitEngine
+from gubernator_tpu.net.faults import FAULTS, SEAM_ENGINE_DISPATCH
+from gubernator_tpu.qos.congestion import CongestionController
+
+pytestmark = [
+    pytest.mark.chain,
+    pytest.mark.skipif(not native.available(),
+                       reason="native router unavailable"),
+]
+
+T0 = 1_700_000_000_000
+
+
+def _engine(use_native="on", lanes=64):
+    return RateLimitEngine(capacity_per_shard=256, batch_per_shard=lanes,
+                           global_capacity=16, global_batch_per_shard=8,
+                           max_global_updates=8, use_native=use_native)
+
+
+def _batcher(eng, stride, depth=None, now=T0, linger=None):
+    b = WindowBatcher(eng, BehaviorConfig())
+    assert b.pipeline is not None and b.pipeline.enabled
+    p = b.pipeline
+    p.now_fn = lambda: now
+    b.now_fn = lambda: now
+    p.depth = depth if depth is not None else max(2, stride + 1)
+    p.gate_enabled = False
+    # the sub-ms coalesce window merges this suite's small test batches
+    # into ONE drain (its job is RPC amortization, not correctness) — off,
+    # so consecutive submits really ride separate chained drains
+    p.coalesce_wait = 0.0
+    p.fetch_stride = stride
+    p.fetch_stride_max = max(stride, p.fetch_stride_max)
+    if linger is not None:
+        p.chain_linger = linger
+    return b
+
+
+def _check(got, want, tag=""):
+    assert len(got) == len(want)
+    for j, (g, r) in enumerate(zip(got, want)):
+        assert (int(g.status), g.limit, g.remaining, g.reset_time) == \
+            (int(r.status), r.limit, r.remaining, r.reset_time), (tag, j, g, r)
+
+
+def _burst(rng, n=48, keys=12):
+    return [
+        RateLimitReq(name="ch", unique_key=f"k{rng.integers(0, keys)}",
+                     hits=int(rng.integers(0, 3)), limit=20,
+                     duration=60_000,
+                     algorithm=int(rng.integers(0, 2)))
+        for _ in range(n)
+    ]
+
+
+def _stall(pipe, seconds):
+    """Hold the single engine thread busy so subsequently pumped drains
+    queue behind it and dispatch back-to-back — a deterministic way to
+    build a multi-member chain without racing wall-clock sleeps."""
+    pipe._engine_executor.submit(time.sleep, seconds)
+
+
+@pytest.mark.parametrize("stride", [1, 2, 8])
+def test_stride_bit_identical_to_serial_oracle(stride):
+    """Multi-window bursts at fetch stride 1/2/8 must be bit-identical to
+    the oracle replaying the same bursts — the chain defers ONLY the
+    host fetch, never the device commit."""
+    eng = _engine()
+    ref = _engine(False)
+    rng = np.random.default_rng(17 + stride)
+    for w in range(4):
+        now = T0 + w * 500
+        b = _batcher(eng, stride, now=now)
+        reqs = _burst(rng)
+
+        async def run():
+            return await asyncio.gather(*(b.submit(r) for r in reqs))
+
+        got = asyncio.run(run())
+        b.close()
+        want = ref.process(reqs, now=now)
+        _check(got, want, (stride, w))
+
+
+@pytest.mark.parametrize("stride", [2, 4])
+def test_chained_drains_share_one_fetch(stride):
+    """Drains queued behind a stalled engine thread chain up and ride ONE
+    stacked fetch: fetch_elided counts the collapsed round trips, and the
+    per-batch results still match sequential oracle replay."""
+    eng = _engine()
+    ref = _engine(False)
+    rng = np.random.default_rng(43)
+    batches = [[RateLimitReq(name="sf", unique_key=f"c{rng.integers(0, 6)}",
+                             hits=1, limit=40, duration=60_000,
+                             algorithm=int(rng.integers(0, 2)))
+                for _ in range(16)] for _ in range(stride)]
+    b = _batcher(eng, stride, depth=stride + 1, linger=5.0)
+    pipe = b.pipeline
+
+    async def run():
+        _stall(pipe, 0.1)
+        tasks = []
+        for batch in batches:
+            tasks.append(asyncio.ensure_future(b.submit_now(batch)))
+            await asyncio.sleep(0)  # let this batch pump its own drain
+        return await asyncio.gather(*tasks)
+
+    try:
+        got = asyncio.run(run())
+    finally:
+        b.close()
+    for i, batch in enumerate(batches):
+        _check(got[i], ref.process(batch, now=T0), i)
+    assert pipe.fetch_elided >= stride - 1, pipe.overlap_snapshot()
+    assert pipe.chain_flushes >= 1
+
+
+def test_global_interleave_mid_chain_matches_oracle():
+    """GLOBAL singles (listed lane, reconciliation accumulate) interleaved
+    with chained traffic at stride 4: per-request results match the
+    oracle — both lanes commit through the same ordered engine thread,
+    and deferring the fetch moves no commit."""
+    eng = _engine()
+    ref = _engine(False)
+    rng = np.random.default_rng(59)
+    for w in range(3):
+        now = T0 + w * 500
+        b = _batcher(eng, 4, now=now)
+        reqs = []
+        for i in range(36):
+            if i % 4 == 0:
+                reqs.append(RateLimitReq(
+                    name="chg", unique_key=f"g{rng.integers(0, 3)}", hits=1,
+                    limit=25, duration=60_000, behavior=Behavior.GLOBAL))
+            else:
+                reqs.append(RateLimitReq(
+                    name="chg", unique_key=f"r{rng.integers(0, 8)}", hits=1,
+                    limit=25, duration=60_000,
+                    algorithm=int(rng.integers(0, 2))))
+
+        async def run():
+            return await asyncio.gather(*(b.submit(r) for r in reqs))
+
+        got = asyncio.run(run())
+        b.close()
+        want = ref.process(reqs, now=now)
+        _check(got, want, w)
+
+
+def test_dispatch_fault_mid_chain_no_partial_commit():
+    """Drain 3 faults at engine_dispatch while drains 1-2 sit chained:
+    the faulted drain fails WHOLE — the C router staging is aborted, so a
+    hits=0 probe sees its keys untouched — and the fault flushes the
+    chain immediately, committing members 1-2 (their donated device
+    state advanced at dispatch; a chained member that has dispatched is
+    committed, only its fetch was pending)."""
+    eng = _engine()
+    b = _batcher(eng, 4, depth=5, linger=10.0)
+    pipe = b.pipeline
+    mk = lambda pfx, hits: [RateLimitReq(
+        name="fc", unique_key=f"{pfx}{i}", hits=hits, limit=10,
+        duration=60_000) for i in range(5)]
+    r1, r2, r3 = mk("a", 3), mk("b", 3), mk("x", 3)
+
+    async def run():
+        _stall(pipe, 0.15)
+        t1 = asyncio.ensure_future(b.submit_now(r1))
+        await asyncio.sleep(0)
+        t2 = asyncio.ensure_future(b.submit_now(r2))
+        await asyncio.sleep(0)
+        # queue a second stall BETWEEN drain 2 and drain 3 on the engine
+        # thread, giving the loop a deterministic window to arm the fault
+        # after 1-2 dispatched (and chained) but before 3 dispatches
+        _stall(pipe, 0.3)
+        t3 = asyncio.ensure_future(b.submit_now(r3))
+        await asyncio.sleep(0.25)
+        assert pipe.overlap_snapshot()["chained_pending"] == 2
+        flushes_before = pipe.chain_flushes
+        FAULTS.seed(7)
+        FAULTS.configure(SEAM_ENGINE_DISPATCH, drop=1.0, times=1)
+        try:
+            got1 = await t1
+            got2 = await t2
+            with pytest.raises(Exception):
+                await t3
+        finally:
+            FAULTS.clear()
+        assert pipe.chain_flushes == flushes_before + 1
+        probes = await b.submit_now(mk("a", 0) + mk("b", 0) + mk("x", 0))
+        return got1, got2, probes
+
+    try:
+        got1, got2, probes = asyncio.run(run())
+    finally:
+        FAULTS.clear()
+        b.close()
+    ref = _engine(False)
+    _check(got1, ref.process(r1, now=T0), "r1")
+    _check(got2, ref.process(r2, now=T0), "r2")
+    for p in probes[:10]:   # r1/r2 keys: the chained commit landed
+        assert p.error == "" and p.remaining == 7, p
+    for p in probes[10:]:   # r3 keys: the faulted drain committed nothing
+        assert p.error == "" and p.remaining == 10, p
+    assert pipe._in_flight == 0
+
+
+def test_chain_fetch_failure_fails_every_member():
+    """One stacked fetch is one failure domain: if the group device_get
+    dies, EVERY chained member's jobs fail — and the pipeline keeps
+    serving afterwards."""
+    eng = _engine()
+    b = _batcher(eng, 2, depth=3, linger=5.0)
+    pipe = b.pipeline
+    real = eng.fetch_stacked_many
+    armed = {"on": True}
+
+    def broken(arrs):
+        if armed.pop("on", None):
+            raise RuntimeError("injected stacked-fetch failure")
+        return real(arrs)
+
+    eng.fetch_stacked_many = broken
+    mk = lambda pfx: [RateLimitReq(name="ff", unique_key=f"{pfx}{i}", hits=1,
+                                   limit=10, duration=60_000)
+                      for i in range(4)]
+    r1, r2 = mk("p"), mk("q")
+
+    async def run():
+        _stall(pipe, 0.1)
+        t1 = asyncio.ensure_future(b.submit_now(r1))
+        await asyncio.sleep(0)
+        t2 = asyncio.ensure_future(b.submit_now(r2))
+        with pytest.raises(Exception):
+            await t1
+        with pytest.raises(Exception):
+            await t2
+        # the pipeline survives: a fresh submit serves normally
+        return await b.submit_now(mk("r"))
+
+    try:
+        got = asyncio.run(run())
+    finally:
+        b.close()
+    for g in got:
+        assert g.error == "" and g.remaining == 9, g
+    assert pipe._in_flight == 0
+
+
+def test_commit_ordering_under_out_of_order_chain_fetch():
+    """Delay the FIRST chain group's stacked fetch so a LATER group
+    completes first: responses still match the oracle — per-key state
+    was committed at dispatch, the chain fetch only demuxes."""
+    eng = _engine()
+    ref = _engine(False)
+    b = _batcher(eng, 2, depth=3, linger=5.0)
+    pipe = b.pipeline
+
+    order = []
+    inner = pipe._complete_chain_sync
+    slow = {"armed": True}
+
+    def tardy(group):
+        if slow.pop("armed", None):
+            time.sleep(0.15)
+        out = inner(group)
+        order.append(sum(r.n_decisions for r in group))
+        return out
+
+    pipe._complete_chain_sync = tardy
+
+    b1 = [RateLimitReq(name="oc", unique_key=f"a{i}", hits=1, limit=9,
+                       duration=60_000) for i in range(8)]
+    b2 = [RateLimitReq(name="oc", unique_key=f"b{i}", hits=1, limit=9,
+                       duration=60_000, algorithm=Algorithm.LEAKY_BUCKET)
+          for i in range(5)]
+
+    async def run():
+        t1 = asyncio.ensure_future(b.submit_now(b1))
+        await asyncio.sleep(0.02)  # group 1 flushed, its fetch now sleeping
+        t2 = asyncio.ensure_future(b.submit_now(b2))
+        return await asyncio.gather(t1, t2)
+
+    try:
+        got1, got2 = asyncio.run(run())
+    finally:
+        b.close()
+    assert order == [len(b2), len(b1)], order
+    _check(got1, ref.process(b1, now=T0), "b1")
+    _check(got2, ref.process(b2, now=T0), "b2")
+
+
+# ---------------------------------------------------------------- adaptive
+
+
+def _controller(now=None, **over):
+    conf = QoSConfig(**over)
+    clock = {"t": 0.0}
+    cc = CongestionController(conf, now_fn=lambda: clock["t"])
+    return cc, clock
+
+
+def test_adaptive_stride_grows_under_backlog_and_shrinks_idle():
+    cc, clock = _controller()
+    cc.observe_drain(0.01)          # healthy latency: not congested
+    assert cc.effective_stride() == 1
+    for i in range(3):
+        cc.observe_chain(backlog_windows=2.0, cap=8)
+        assert cc.effective_stride() == 2 + i  # unit additive growth
+    for _ in range(20):
+        cc.observe_chain(backlog_windows=2.0, cap=8)
+    assert cc.effective_stride() == 8          # capped at the operator max
+    # light load: multiplicative collapse toward 1 (fetch every drain)
+    shrinks = cc.stride_decreases
+    cc.observe_chain(backlog_windows=0.0, cap=8)
+    assert cc.effective_stride() < 8
+    while cc.effective_stride() > 1:
+        cc.observe_chain(backlog_windows=0.0, cap=8)
+    assert cc.stride_decreases > shrinks
+    # and it never underflows 1
+    cc.observe_chain(backlog_windows=0.0, cap=8)
+    assert cc.effective_stride() == 1
+
+
+def test_adaptive_stride_backs_off_under_congestion():
+    """Deep backlog does NOT grow the stride while the drain latency EWMA
+    is over target — chaining under congestion would add latency on top
+    of latency."""
+    cc, clock = _controller(target_drain_latency=0.05)
+    cc.observe_drain(0.01)
+    for _ in range(4):
+        cc.observe_chain(backlog_windows=3.0, cap=8)
+    grown = cc.effective_stride()
+    assert grown == 5
+    clock["t"] += 1.0
+    cc.observe_drain(10.0)          # latency blows past target: congested
+    assert cc.congested
+    cc.observe_chain(backlog_windows=3.0, cap=8)
+    assert cc.effective_stride() < grown
+
+
+def test_stride_bound_respects_deadline():
+    """The deepest admissible stride is (budget - t_fetch) / t_exec at
+    the observed stage EWMAs — the oldest chained member must still
+    commit inside the propagated admission deadline."""
+    cc, _ = _controller()
+    # unobserved stages: no evidence to cap on
+    assert cc.stride_bound(0.1) == 1 << 30
+    assert cc.stride_bound(0.0) == 1 << 30   # no deadline configured
+    cc.observe_stages(host=0.001, device=0.01, fetch=0.02)
+    assert cc.stride_bound(0.1) == 8         # (0.1 - 0.02) / 0.01
+    assert cc.stride_bound(0.015) == 1       # budget under one fetch
+
+
+def test_pipeline_stride_policy_composes_floor_cap_and_bound():
+    """_stride_current = clamp(max(operator floor, AIMD stride),
+    operator cap, deadline bound); lockstep always 1."""
+    eng = _engine()
+    b = _batcher(eng, 2)
+    pipe = b.pipeline
+    try:
+        pipe.fetch_stride, pipe.fetch_stride_max = 2, 6
+        cc, _ = _controller()
+        pipe.qos = types.SimpleNamespace(
+            congestion=cc, conf=types.SimpleNamespace(default_deadline=0.0))
+        cc.observe_drain(0.01)
+        assert pipe._stride_current() == 2       # floor rules while AIMD=1
+        for _ in range(10):
+            cc.observe_chain(backlog_windows=2.0, cap=8)
+        assert pipe._stride_current() == 6       # AIMD grew, operator cap
+        cc.observe_stages(host=0.001, device=0.01, fetch=0.02)
+        pipe.qos.conf.default_deadline = 0.05    # bound: (0.05-0.02)/0.01
+        assert pipe._stride_current() == 3
+        pipe.lockstep = True
+        assert pipe._stride_current() == 1       # collectives never chain
+    finally:
+        pipe.lockstep = False
+        pipe.qos = None
+        b.close()
+
+
+def test_single_drain_flushes_immediately_at_idle():
+    """Light load degenerates to stride 1: an isolated drain with nothing
+    queued behind it flushes its chain of ONE without waiting for the
+    stride or the linger timer — no added latency."""
+    eng = _engine()
+    b = _batcher(eng, 8, linger=30.0)   # linger long enough to fail a wait
+    pipe = b.pipeline
+    reqs = [RateLimitReq(name="id", unique_key=f"i{i}", hits=1, limit=10,
+                         duration=60_000) for i in range(6)]
+
+    async def run():
+        t0 = time.monotonic()
+        got = await asyncio.wait_for(b.submit_now(reqs), timeout=10)
+        return got, time.monotonic() - t0
+
+    try:
+        got, wall = asyncio.run(run())
+    finally:
+        b.close()
+    for g in got:
+        assert g.error == "" and g.remaining == 9
+    assert wall < 5.0                    # never waited out the 30s linger
+    assert pipe.chain_flushes >= 1 and pipe.fetch_elided == 0

@@ -41,6 +41,10 @@ NATIVE_NAMES = (
     "guber_tpu_pipeline_inflight_windows",
     "guber_tpu_pipeline_overlap_ratio",
     "guber_tpu_window_buffer_reuse_total",
+    # deferred-fetch dispatch chain (core/pipeline.py)
+    "guber_tpu_chain_fetch_stride",
+    "guber_tpu_chain_inflight_windows",
+    "guber_tpu_chain_fetch_elided_total",
     # multi-process front door (frontdoor.py, core/shm_ring.py)
     "guber_tpu_frontdoor_workers",
     "guber_tpu_frontdoor_rpcs",

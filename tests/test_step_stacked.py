@@ -104,3 +104,103 @@ def test_stacked_global_lanes_match_sequential():
     assert got[0][0].remaining == want[0][0].remaining
     # window 1 sees the psum-applied hit from window 0 (one decrement)
     assert got[1][0].remaining == want[1][0].remaining
+
+
+def _inert_stack(eng, k):
+    """A K-window stack with zero GLOBAL lanes and inert control — the
+    shape step_windows routes to the GLOBAL-skipping executable."""
+    import numpy as np
+
+    from gubernator_tpu.core.engine import WindowBatch
+    from gubernator_tpu.ops import kernel
+
+    SL, B = eng.num_local_shards, eng.batch_per_shard
+    gb, ga, upd, ups = eng.empty_control()
+    stk = lambda a: np.stack([a] * k)  # noqa: E731
+    batches = WindowBatch(
+        slot=np.full((k, SL, B), kernel.PAD_SLOT, np.int32),
+        hits=np.zeros((k, SL, B), np.int64),
+        limit=np.zeros((k, SL, B), np.int64),
+        duration=np.zeros((k, SL, B), np.int64),
+        algo=np.zeros((k, SL, B), np.int32),
+        is_init=np.zeros((k, SL, B), bool))
+    return (batches, WindowBatch(*[stk(a) for a in gb]), stk(ga),
+            upd, ups, np.full((k,), T0, np.int64))
+
+
+def test_empty_global_skip_census():
+    """The GLOBAL-skipping stacked variant must execute strictly fewer
+    kernels than the composed twin: the per-window GLOBAL gathers,
+    scatters and psum are gone, and the once-per-stack control apply is
+    gone too (op-count cut the round-5 calibration prescribes)."""
+    import jax
+
+    from gubernator_tpu.core import engine as eng_mod
+    from gubernator_tpu.ops import pallas_kernel as pk
+
+    eng = make_engine(False)
+    args = _inert_stack(eng, 2)
+    full = jax.make_jaxpr(eng_mod._compiled_multi_step(eng.mesh))(
+        eng.state, eng.gstate, eng.gcfg, *args)
+    skip = jax.make_jaxpr(
+        eng_mod._compiled_multi_step(eng.mesh, with_global=False))(
+        eng.state, eng.gstate, eng.gcfg, *args)
+    cf, cs = pk.kernel_census(full), pk.kernel_census(skip)
+    assert cs < cf, (
+        f"GLOBAL-skip variant census {cs} not below composed census {cf}")
+
+
+def test_empty_global_skip_matches_sequential(monkeypatch):
+    """A no-GLOBAL stack must route to the skipping executable AND stay
+    bit-identical to sequential step() — the zero-filled GLOBAL rows in
+    the fused output never reach a response."""
+    from gubernator_tpu.core import engine as eng_mod
+
+    picked = []
+    real = eng_mod._compiled_multi_step
+
+    def spy(mesh, with_global=True):
+        picked.append(with_global)
+        return real(mesh, with_global=with_global)
+
+    monkeypatch.setattr(eng_mod, "_compiled_multi_step", spy)
+
+    rng = np.random.default_rng(7)
+    wins = [[RateLimitReq(name="nog", unique_key=f"k{rng.integers(0, 20)}",
+                          hits=int(rng.integers(0, 3)), limit=10,
+                          duration=60_000,
+                          algorithm=int(rng.integers(0, 2)))
+             for _ in range(16)] for _ in range(3)]
+
+    ref = make_engine(False)
+    want = [ref.step(w, now=T0) for w in wins]
+    eng = make_engine(False)
+    got = eng.step_stacked(wins, now=T0)
+
+    assert False in picked, "no-GLOBAL stack never took the skip variant"
+    for k, (gw, ww) in enumerate(zip(got, want)):
+        for j, (g, r) in enumerate(zip(gw, ww)):
+            assert (g.status, g.limit, g.remaining, g.reset_time) == \
+                (r.status, r.limit, r.remaining, r.reset_time), (k, j)
+
+
+def test_global_stack_keeps_composed_variant(monkeypatch):
+    """Any live GLOBAL lane (or non-inert control) must keep the composed
+    executable — the skip gate is for provably-inert stacks only."""
+    from gubernator_tpu.core import engine as eng_mod
+
+    picked = []
+    real = eng_mod._compiled_multi_step
+
+    def spy(mesh, with_global=True):
+        picked.append(with_global)
+        return real(mesh, with_global=with_global)
+
+    monkeypatch.setattr(eng_mod, "_compiled_multi_step", spy)
+
+    eng = make_engine(False)
+    reqs = [RateLimitReq(name="gg", unique_key="h", hits=1, limit=20,
+                         duration=60_000, behavior=Behavior.GLOBAL)]
+    eng.step_stacked([reqs, reqs], now=T0)
+    assert False not in picked, (
+        "stack with live GLOBAL lanes routed to the skip variant")
