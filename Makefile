@@ -1,7 +1,7 @@
 # Dev targets (the reference Makefile:1-15 has only release/docker; we add
 # the working set).
 
-.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos test-obs test-chaos test-analytics test-overlap test-chain test-frontdoor proto bench bench-smoke docker lint cluster
+.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos test-obs test-chaos test-analytics test-overlap test-chain test-frontdoor test-tiers proto bench bench-smoke docker lint cluster
 
 test:
 	python -m pytest tests/ -x -q
@@ -75,6 +75,14 @@ test-chain:
 test-frontdoor:
 	python -m pytest tests/ -x -q -m "frontdoor and not slow"
 
+# the tiered key-state slice: warm-tier engine bit-identical to the
+# unbounded-arena oracle under Zipf traffic (incl. demote→re-promote in
+# one drain), O(1) SlotTable.stats vs a fresh scan, warm snapshot
+# persistence, version-mismatch cold-start degradation.  Part of tier-1
+# (`test-core` picks it up too); this target runs just the slice.
+test-tiers:
+	python -m pytest tests/ -x -q -m "tiers and not slow"
+
 proto:
 	cd gubernator_tpu/api/proto && protoc --python_out=. gubernator.proto peers.proto
 
@@ -89,12 +97,15 @@ bench:
 # reports e2e decisions/s + shm ring stall % through the worker path.
 # Finally the chain probe sweeps the deferred-fetch stride (raw link +
 # simulated tunnel RTT) and prints the device-tier vs serving-drain
-# reconciliation (kernel census + per-dispatch wall).
+# reconciliation (kernel census + per-dispatch wall), and the tier probe
+# sweeps arena fraction under Zipf traffic (warm hit rate, promotions/s,
+# window p99, tiers-on vs tiers-off).
 bench-smoke:
 	python scripts/bench_compare.py
 	GUBER_PROBE_PLATFORM=cpu python scripts/probe_overlap.py
 	GUBER_PROBE_PLATFORM=cpu GUBER_PROBE_FD_WORKERS=0,2 GUBER_PROBE_SECONDS=2 python scripts/probe_frontdoor.py
 	GUBER_PROBE_PLATFORM=cpu GUBER_PROBE_B=1024 GUBER_PROBE_C=4096 GUBER_PROBE_SECONDS=1 python scripts/probe_chain.py
+	GUBER_PROBE_PLATFORM=cpu GUBER_PROBE_TIER_NS=8192 GUBER_PROBE_TIER_WINDOWS=120 GUBER_PROBE_B=128 python scripts/probe_tiers.py
 
 docker:
 	docker build -t gubernator-tpu:latest .

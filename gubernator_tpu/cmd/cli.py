@@ -220,6 +220,16 @@ def cmd_debug(args) -> int:
               f"inits={tot.get('inits', 0)} "
               f"device_occupancy={occ.get('live', 0)} live/"
               f"{occ.get('expired', 0)} expired")
+    tiers = snap.get("tiers")
+    if tiers:
+        print(f"tiers: warm={tiers.get('warm_rows', 0)}/"
+              f"{tiers.get('warm_capacity', 0)} rows "
+              f"({tiers.get('warm_layout')}, {tiers.get('warm_bytes', 0)}B) "
+              f"promote={tiers.get('promotions', 0)} "
+              f"demote={tiers.get('demotions', 0)} "
+              f"warm_hit={tiers.get('warm_hits', 0)} "
+              f"cold_miss={tiers.get('cold_misses', 0)} "
+              f"warm_evict={tiers.get('warm_evictions', 0)}")
     slo = snap.get("slo")
     if slo:
         for name, obj in sorted(slo.get("burn_rates", {}).items()):

@@ -226,6 +226,53 @@ class AnalyticsConfig:
 
 
 @dataclass
+class TierConfig:
+    """Tiered key state (state/tiers.py): a host-side warm store behind
+    the fixed HBM arena, turning slot exhaustion into a cache-miss cost
+    over an unbounded keyspace.  Default-off (warm_rows=0): the hot path
+    stays byte-identical to the single-tier engine.  Requires the Python
+    routing backend and a single-process engine (config_from_env forces
+    use_native=False when tiers are enabled).  Defaults read GUBER_TIER_*
+    at construction (trace_sample pattern) so library embedders get the
+    same knobs as the daemon.  No reference analog — the reference's LRU
+    simply drops the coldest bucket's counters on the floor."""
+
+    # Warm-store capacity in rows; 0 disables tiers entirely.
+    warm_rows: int = field(
+        default_factory=lambda: env_int("GUBER_TIER_WARM", 0, minimum=0))
+    # Warm row layout: "int64" (absolute times) or "compact32" (int32
+    # values + pair-rebased int32 times vs the store epoch — half the
+    # bytes; rows outside the rebase range fall back to an int64 side
+    # map, so the choice is never lossy).
+    layout: str = field(
+        default_factory=lambda: _env("GUBER_TIER_LAYOUT", "int64"))
+    # LRU-head candidates ranked by analytics heat when picking a live
+    # demotion victim (1 = strict LRU, the seed policy).
+    victim_sample: int = field(
+        default_factory=lambda: env_int("GUBER_TIER_VICTIM_SAMPLE", 8))
+    # Proactive demotion: tier_maintain spills cold entries once a
+    # shard's table runs above this occupancy fraction, demote_batch rows
+    # per pass.
+    demote_watermark: float = field(
+        default_factory=lambda: env_float("GUBER_TIER_DEMOTE_WATERMARK",
+                                          0.9, minimum=0.1))
+    demote_batch: int = field(
+        default_factory=lambda: env_int("GUBER_TIER_DEMOTE_BATCH", 64))
+
+    @property
+    def enabled(self) -> bool:
+        return self.warm_rows > 0
+
+    def validate(self) -> None:
+        if self.layout not in ("int64", "compact32"):
+            raise ValueError(
+                f"GUBER_TIER_LAYOUT must be int64 or compact32, "
+                f"got {self.layout!r}")
+        if not (0.1 <= self.demote_watermark <= 1.0):
+            raise ValueError("Tier.demote_watermark must be in [0.1, 1.0]")
+
+
+@dataclass
 class SLOConfig:
     """SLO burn-rate engine (observability/analytics.py SLOEngine):
     multi-window multi-burn-rate alerting over configured objectives.
@@ -301,6 +348,7 @@ class Config:
     health: HealthConfig = field(default_factory=HealthConfig)
     analytics: AnalyticsConfig = field(default_factory=AnalyticsConfig)
     slo: SLOConfig = field(default_factory=SLOConfig)
+    tiers: TierConfig = field(default_factory=TierConfig)
     # advertise address used for self-identification in the peer ring
     advertise_address: str = ""
     # Request tracing (observability/tracing.py): probability a request
@@ -386,6 +434,7 @@ class DaemonConfig:
     health: HealthConfig = field(default_factory=HealthConfig)
     analytics: AnalyticsConfig = field(default_factory=AnalyticsConfig)
     slo: SLOConfig = field(default_factory=SLOConfig)
+    tiers: TierConfig = field(default_factory=TierConfig)
 
     @property
     def k8s_enabled(self) -> bool:
@@ -612,5 +661,19 @@ def config_from_env(env_file: Optional[str] = None) -> DaemonConfig:
     c.analytics.validate()
     c.slo = SLOConfig()
     c.slo.validate()
+
+    # Tiered key state: rebuild after load_env_file like analytics/slo.
+    # The warm tier lives in the Python routing tables (the native router
+    # keeps fingerprints, not key strings), so enabling it pins the
+    # backend — loudly, because GUBER_NATIVE=1 + GUBER_TIER_WARM>0 would
+    # otherwise fail at enable_tiers during boot.
+    c.tiers = TierConfig()
+    c.tiers.validate()
+    if c.tiers.enabled and e.use_native not in (False, "off"):
+        import logging
+        logging.getLogger("gubernator.config").info(
+            "GUBER_TIER_WARM=%d enables the warm tier; forcing the Python "
+            "routing backend (use_native=False)", c.tiers.warm_rows)
+        e.use_native = False
 
     return c

@@ -16,6 +16,7 @@ gubernator.go:237).
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence
@@ -29,6 +30,8 @@ from gubernator_tpu.core.window_buffers import RequestColumns
 from gubernator_tpu.net.faults import FAULTS, SEAM_ENGINE_DISPATCH
 from gubernator_tpu.qos import interleave_by_tenant, shed_response
 from gubernator_tpu.qos.fairness import tenant_of
+
+log = logging.getLogger("gubernator.batcher")
 
 
 class WindowBatcher:
@@ -282,13 +285,16 @@ class WindowBatcher:
             nonlocal before
             before = self.engine.windows_processed
             if stacked:
-                return self.engine.step_stacked(
+                resps = self.engine.step_stacked(
                     [[t[0] for t in w] for w in windows], now,
                     [[t[1] for t in w] for w in windows],
                     k_stack=self.behaviors.lockstep_stack)
-            w = windows[0]
-            return [self.engine.step([t[0] for t in w], now,
-                                     [t[1] for t in w])]
+            else:
+                w = windows[0]
+                resps = [self.engine.step([t[0] for t in w], now,
+                                          [t[1] for t in w])]
+            self._tier_maintain(now)
+            return resps
 
         def run_empty():
             if stacked:
@@ -349,6 +355,19 @@ class WindowBatcher:
             for (_, _, fut), resp in zip(w, rs):
                 if not fut.done():
                     fut.set_result(resp)
+
+    def _tier_maintain(self, now) -> None:
+        """Proactive warm-tier demotion between windows (state/tiers.py).
+        Runs on the engine executor right after a drain, where the device
+        rows are current; a no-op attribute check when tiers are off.
+        Never fails the window — maintenance is an optimization, forced
+        eviction inside staging still covers correctness."""
+        if self.engine._tiers is None:
+            return
+        try:
+            self.engine.tier_maintain(now)
+        except Exception:
+            log.exception("warm-tier maintenance failed; continuing")
 
     # ------------------------------------------------------------- batched
 
@@ -462,8 +481,10 @@ class WindowBatcher:
                 prof.before_drain()
             try:
                 now = self.now_fn() if self.now_fn is not None else None
-                return self.engine.process(reqs, now, accumulate,
-                                           columns=columns)
+                resps = self.engine.process(reqs, now, accumulate,
+                                            columns=columns)
+                self._tier_maintain(now)
+                return resps
             finally:
                 if profiling:
                     prof.after_drain()

@@ -23,6 +23,7 @@ bit-exact.  Host-side key→slot tables (state/arena.py) are per shard.
 
 from __future__ import annotations
 
+import logging
 import zlib
 from functools import lru_cache
 from typing import List, Optional, Sequence
@@ -51,6 +52,8 @@ from gubernator_tpu.ops.kernel import (
 from gubernator_tpu.parallel.mesh import (SHARD_AXIS, make_mesh, shard_spec,
                                           stacked_spec)
 from gubernator_tpu.state.arena import SlotTable
+
+log = logging.getLogger("gubernator.engine")
 
 
 # Stacked-window buckets for the serving pipeline (core/pipeline.py): a
@@ -258,6 +261,11 @@ class RateLimitEngine:
         self._lane_bucket_list = sorted(
             {b for b in (max(64, B // 16), max(64, B // 4)) if b < B} | {B})
 
+        # Tiered key state (state/tiers.py): installed by enable_tiers on
+        # Python-routed single-process engines; None = single-tier seed
+        # behavior, byte-identical hot path
+        self._tiers = None
+
         # Native C++ window router (gubernator_tpu/native): batch key hashing,
         # shard routing, slot lookup + LRU in one C call per window, replacing
         # the per-key Python dict path.  The two backends are exclusive —
@@ -372,6 +380,8 @@ class RateLimitEngine:
         for i, slot in enumerate(greset):
             buf.rslot[i] = slot
 
+        if self._tiers is not None:
+            self._tier_fence(now)
         out, gout = self._dispatch(
             now, reg_fill=max_fill, fetch_global=g_count > 0)
         for t in self.tables:
@@ -454,7 +464,19 @@ class RateLimitEngine:
                         f"key {key!r} belongs to shard "
                         f"{shard_of(key, S)}, not owned by this process — "
                         "the serving layer must route it to the owning host")
-                slot, is_init = self.tables[s].lookup(key, now, r.duration)
+                slot = None
+                is_init = False
+                if self._tiers is not None and key not in self.tables[s]:
+                    # warm-tier rehydration: a demoted key re-enters the hot
+                    # arena with its LIVE row (scattered at the pre-dispatch
+                    # fence), so the decision matches the infinite-arena
+                    # oracle bit for bit; a miss in warm too falls through
+                    # to the ordinary cold-init lookup
+                    slot = self._tiers.stage_promote(
+                        s, self.tables[s], key, now, r.duration)
+                if slot is None:
+                    slot, is_init = self.tables[s].lookup(
+                        key, now, r.duration)
                 lane = reg_fill[s]
                 reg_fill[s] += 1
                 buf.slot[s, lane] = slot
@@ -585,6 +607,11 @@ class RateLimitEngine:
                                is_init=st.gis_init)
         nows = np.full((K,), now, np.int64)
 
+        if self._tiers is not None:
+            # one fence covers the whole stack: begin_window ran ONCE above,
+            # so every spill/promotion staged across the K windows resolves
+            # here, before the single fused dispatch reads the arena
+            self._tier_fence(now)
         try:
             fused = self.step_windows(
                 batches, gbatches, st.ghits_acc,
@@ -1753,10 +1780,16 @@ class RateLimitEngine:
                   np.asarray([e[1] for e in gents], np.int32),
                   np.asarray([e[2] for e in gents], np.int64))
 
+        warm = None
+        if self._tiers is not None:
+            # the warm tier rides the same snapshot: rows exported in
+            # canonical int64 absolute form (dumps re-encodes per layout)
+            warm = self._tiers.warm.export_rows()
+
         if layout == "auto":
             layout = "compact32" if self._compact_sound else "int64"
         return ArenaSnapshot(
-            now=now, layout=layout,
+            now=now, layout=layout, warm=warm,
             num_shards=self.num_shards,
             capacity_per_shard=self.capacity_per_shard,
             global_capacity=self.global_capacity,
@@ -1865,6 +1898,26 @@ class RateLimitEngine:
             gkeys, np.asarray(gslots, np.int64).tolist(),
             (np.asarray(gexps, np.int64) + shift).tolist()))
         self._gpending = set(snap.gpending)
+        warm = getattr(snap, "warm", None)
+        if self._tiers is not None:
+            from gubernator_tpu.state.tiers import WarmStore
+            tm = self._tiers
+            now_r = self._resolve_now(rebase_to)
+            # import replaces ALL key state: rebuild the warm store fresh
+            # (new epoch == the restore clock) and re-insert the snapshot's
+            # warm rows with the same shift as the arenas
+            tm.warm = WarmStore(tm.conf.warm_rows, tm.conf.layout,
+                                epoch=now_r)
+            tm.pending_spills.clear()
+            tm.pending_promos.clear()
+            if warm is not None:
+                tm.warm.restore_rows(warm[0], warm[1], now=now_r,
+                                     shift=shift)
+        elif warm is not None and len(warm[0]):
+            log.warning(
+                "snapshot carries %d warm-tier rows but tiers are disabled "
+                "on this engine; dropping them to cold (keys re-init from "
+                "request configs)", len(warm[0]))
         if not snap.compact_sound:
             # the snapshotted arena held out-of-range configs; the compact
             # wire could saturate serving them, same guard as the live path
@@ -2090,6 +2143,191 @@ class RateLimitEngine:
                 self.tables[s].remove(key)
                 removed += 1
         return removed
+
+    # --------------------------------------------------------- tiered state
+    #
+    # Warm tier (state/tiers.py): the fixed arena becomes a managed cache
+    # over an unbounded keyspace.  Demotion rides SlotTable._reclaim via
+    # the spill hook; promotion happens in _stage_requests; both resolve in
+    # ONE batched gather + scatter at the pre-dispatch fence below.  All of
+    # it runs on the dispatch thread (same quiesce contract as migration).
+
+    def enable_tiers(self, conf, analytics=None,
+                     epoch: Optional[int] = None):
+        """Install the warm tier.  Requires Python routing tables and a
+        single-process engine — the same constraint as live key migration
+        (the native router keeps fingerprints, not key strings, and a mesh
+        resizes by re-sharding rather than spilling).  `epoch` anchors the
+        warm store's compact32 pair-rebase domain (defaults to now)."""
+        from gubernator_tpu.state.tiers import TierManager
+        self._check_migratable()
+        if conf.warm_rows <= 0:
+            raise ValueError(
+                "enable_tiers needs warm capacity (GUBER_TIER_WARM > 0); "
+                "warm_rows=0 means tiers stay off")
+        t = TierManager(conf, epoch=self._resolve_now(epoch),
+                        analytics=analytics)
+        self._tiers = t
+        for s, table in enumerate(self.tables):
+            table.spill_cb = (
+                lambda key, slot, expire, stale, _s=s:
+                t.on_spill(_s, key, slot, expire, stale))
+            table.heat_fn = t.heat
+            table.victim_sample = conf.victim_sample
+        return t
+
+    def tier_stats(self) -> Optional[dict]:
+        """Tier counters + warm occupancy for /metrics and cli debug;
+        None when tiers are off."""
+        return None if self._tiers is None else self._tiers.stats()
+
+    def _tier_fence(self, now: int) -> None:
+        """Resolve every demotion/promotion pending since the last dispatch
+        — BEFORE this window's dispatch, while the victims' device rows are
+        still intact and so the promoted rows are resident when the kernel
+        reads them.  One gather + one scatter per window regardless of how
+        many keys moved; spill rows found dead or expired on device drop to
+        cold (the kernel's lazy expiry already treats them as misses, so
+        the infinite-arena oracle would re-init them too)."""
+        t = self._tiers
+        t.fences += 1
+        if t.analytics is not None and t.fences % 256 == 0:
+            t.refresh_heat()
+        spills, promos = t.drain_pending()
+        if not spills and not promos:
+            return
+        # one gather covers the spills AND the from-spill promotion sources
+        gather = [(k, sh, sl) for k, sh, sl in spills]
+        src_ix = {}
+        for key, p in promos:
+            if p[3] is not None:
+                src_ix[key] = len(gather)
+                gather.append((key, p[3][0], p[3][1]))
+        vals = None
+        if gather:
+            n = len(gather)
+            m = _pad_pow2(n)
+            si = np.full(m, self.num_shards, np.int32)   # OOB pad -> fill 0
+            li = np.full(m, self.capacity_per_shard, np.int32)
+            si[:n] = [g[1] for g in gather]
+            li[:n] = [g[2] for g in gather]
+            got = _gather_rows_jit(self.state, jnp.asarray(si),
+                                   jnp.asarray(li))
+            vals = {f: np.asarray(getattr(got, f))[:n]
+                    for f in BucketState._fields}
+        puts = []
+        for j, (key, _sh, _sl) in enumerate(spills):
+            if vals["expire"][j] <= now:
+                # dead (never written) or already expired on device: cold
+                t.counters["demote_dropped_expired"] += 1
+                continue
+            row = {f: int(vals[f][j]) for f in BucketState._fields}
+            row["key"] = key
+            puts.append(row)
+        if puts:
+            t.warm.put_batch(puts, now)
+            t.counters["demotions"] += len(puts)
+        if promos:
+            rows = []
+            for key, p in promos:
+                if p[3] is not None:
+                    j = src_ix[key]
+                    row = {f: int(vals[f][j]) for f in BucketState._fields}
+                    row["key"] = key
+                    row["rel"] = False
+                else:
+                    row = p[2]
+                rows.append((p[0], p[1], row))
+            t.decode_rows([r for _, _, r in rows])
+            n = len(rows)
+            m = _pad_pow2(n)
+            si = np.full(m, self.num_shards, np.int32)   # OOB pad -> dropped
+            li = np.full(m, self.capacity_per_shard, np.int32)
+            svals = {f: np.zeros(m, np.int64) for f in BucketState._fields}
+            for j, (sh, sl, row) in enumerate(rows):
+                si[j] = sh
+                li[j] = sl
+                for f in BucketState._fields:
+                    svals[f][j] = row[f]
+            self.state = _scatter_rows_jit(
+                self.state, jnp.asarray(si), jnp.asarray(li),
+                BucketState(**{f: jnp.asarray(svals[f])
+                               for f in BucketState._fields}))
+            t.counters["promotions"] += n
+
+    def tier_maintain(self, now: Optional[int] = None) -> int:
+        """Proactive demotion between windows: shards running above the
+        demote watermark spill their coldest committed entries to warm in
+        one batch, so staging under a full arena pays fence-time spills
+        instead of per-lookup forced evictions.  Also refreshes the heat
+        map from analytics.  Returns entries demoted or dropped."""
+        if self._tiers is None:
+            return 0
+        t = self._tiers
+        now = self._resolve_now(now)
+        t.refresh_heat()
+        if t.pending_spills or t.pending_promos:
+            # a staging pass aborted before its dispatch: resolve the
+            # leftovers first (their device rows are still pre-dispatch)
+            self._tier_fence(now)
+        hi = int(t.conf.demote_watermark * self.capacity_per_shard)
+        picks = []
+        for s, table in enumerate(self.tables):
+            excess = len(table) - hi
+            if excess <= 0:
+                continue
+            take = min(excess, t.conf.demote_batch)
+            scanned = 0
+            for key in table.keys():              # LRU order, oldest first
+                if take <= 0 or scanned >= 4 * t.conf.demote_batch:
+                    break
+                scanned += 1
+                if table.is_pending(key) or t.heat(key) > 0.0:
+                    continue                      # hot by analytics: keep
+                picks.append((key, s, table.peek(key)))
+                take -= 1
+        if not picks:
+            return 0
+        n = len(picks)
+        m = _pad_pow2(n)
+        si = np.full(m, self.num_shards, np.int32)
+        li = np.full(m, self.capacity_per_shard, np.int32)
+        si[:n] = [p[1] for p in picks]
+        li[:n] = [p[2] for p in picks]
+        got = _gather_rows_jit(self.state, jnp.asarray(si), jnp.asarray(li))
+        vals = {f: np.asarray(getattr(got, f))[:n]
+                for f in BucketState._fields}
+        puts = []
+        for j, (key, s, _slot) in enumerate(picks):
+            self.tables[s].remove(key)
+            if vals["expire"][j] <= now:
+                t.counters["demote_dropped_expired"] += 1
+                continue
+            row = {f: int(vals[f][j]) for f in BucketState._fields}
+            row["key"] = key
+            puts.append(row)
+        if puts:
+            t.warm.put_batch(puts, now)
+            t.counters["demotions"] += len(puts)
+        return n
+
+    def tier_warmup(self, max_rows: int = 512) -> None:
+        """Pre-compile the fence's gather/scatter pow2 ladder up to
+        `max_rows` so serving never pays the jit stall mid-window (the
+        same contract as warmup(); the helpers compile per padded shape).
+        All-OOB indices make every dispatch a no-op on the arena."""
+        if self._tiers is None:
+            return
+        m = 8
+        while m <= _pad_pow2(max_rows):
+            si = jnp.full(m, self.num_shards, jnp.int32)
+            li = jnp.full(m, self.capacity_per_shard, jnp.int32)
+            got = _gather_rows_jit(self.state, si, li)
+            zeros = BucketState(**{f: jnp.zeros(m, jnp.int64)
+                                   for f in BucketState._fields})
+            self.state = _scatter_rows_jit(self.state, si, li, zeros)
+            jax.block_until_ready(got)
+            m *= 2
 
 
 def _pad_pow2(n: int) -> int:

@@ -483,7 +483,10 @@ class DispatchPipeline:
                 "a multiprocess engine's pipeline must run in lockstep "
                 "mode (tick-driven drains keep the collective sequence "
                 "identical on every process)")
-        self.enabled = engine.native is not None
+        # Requires the native router; tiers (state/tiers.py) imply Python
+        # routing so the gate below stays False with tiers on — defensive,
+        # since enable_tiers already rejects native engines.
+        self.enabled = engine.native is not None and engine._tiers is None
         self.metrics = metrics
         self._engine_executor = engine_executor
         self.k_max = k_max

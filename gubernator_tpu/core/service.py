@@ -112,6 +112,16 @@ class Instance:
             self.slo = SLOEngine(self.conf.slo)
         if self.analytics is not None or self.slo is not None:
             self.metrics.watch_analytics(self.analytics, self.slo)
+        # Tiered key state (state/tiers.py).  Off by default (warm_rows=0):
+        # the engine hot path is byte-identical to the single-tier seed.
+        # When on, the warm tier hangs off the engine's Python tables and
+        # feeds on the analytics heat map when that is also enabled.
+        tconf = getattr(self.conf, "tiers", None)
+        if tconf is not None and tconf.enabled:
+            tconf.validate()
+            self.engine.enable_tiers(tconf, analytics=self.analytics)
+            self.engine.tier_warmup()
+            self.metrics.watch_tiers(self.engine)
         self.mesh_mode = mesh_peers is not None
         clock = None
         if self.mesh_mode:

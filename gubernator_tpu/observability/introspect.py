@@ -186,6 +186,9 @@ def build_debug_snapshot(instance) -> dict:
             "tenants": snap["tenants"],
             "topk": snap["topk"][:10],  # the full table lives at /topk
         }
+    tiers = getattr(instance.engine, "tier_stats", lambda: None)()
+    if tiers is not None:
+        out["tiers"] = tiers
     slo = getattr(instance, "slo", None)
     if slo is not None:
         out["slo"] = slo.snapshot()

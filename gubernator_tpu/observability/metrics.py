@@ -254,6 +254,26 @@ class Metrics:
             "existed.",
             registry=self.registry,
         )
+        # tiered key state (state/tiers.py): hot-arena <-> warm-store flow
+        self.tier_events = Counter(
+            "guber_tpu_tier_events_total",
+            "Tiered key-state events by kind: promote/demote row moves, "
+            "warm_hit/cold_miss on staging lookups behind a table miss, "
+            "warm_evict overflow drops, demote_drop dead-or-expired spills, "
+            "demote_stale same-drain victims dropped to cold.",
+            ["event"],
+            registry=self.registry,
+        )
+        self.tier_warm_rows = Gauge(
+            "guber_tpu_tier_warm_rows",
+            "Rows resident in the warm tier.",
+            registry=self.registry,
+        )
+        self.tier_warm_bytes = Gauge(
+            "guber_tpu_tier_warm_bytes",
+            "Host bytes allocated to the warm tier's SoA store.",
+            registry=self.registry,
+        )
         # QoS subsystem (gubernator_tpu/qos/): admission queue, sheds by
         # reason, the AIMD window, and per-peer breaker state
         self.qos_queue_depth = Gauge(
@@ -467,6 +487,37 @@ class Metrics:
                 self.cache_access_count.labels(type="miss").inc(
                     st["misses"] - last["miss"])
                 last["miss"] = st["misses"]
+
+        self.add_scrape_hook(refresh)
+
+    def watch_tiers(self, engine) -> None:
+        """Export the warm tier's occupancy and event counters at scrape
+        time from ONE engine.tier_stats read (same delta pattern as
+        watch_engine: the TierManager keeps plain ints, the scrape
+        advances the prometheus counters by the difference)."""
+        events = {
+            "promote": "promotions",
+            "demote": "demotions",
+            "warm_hit": "warm_hits",
+            "cold_miss": "cold_misses",
+            "warm_evict": "warm_evictions",
+            "demote_drop": "demote_dropped_expired",
+            "demote_stale": "demote_dropped_stale",
+        }
+        last = {k: 0 for k in events}
+
+        def refresh():
+            st = engine.tier_stats()
+            if st is None:
+                return
+            self.tier_warm_rows.set(st["warm_rows"])
+            self.tier_warm_bytes.set(st["warm_bytes"])
+            for label, field in events.items():
+                cur = st[field]
+                if cur > last[label]:
+                    self.tier_events.labels(event=label).inc(
+                        cur - last[label])
+                    last[label] = cur
 
         self.add_scrape_hook(refresh)
 

@@ -99,6 +99,11 @@ class ArenaSnapshot:
     native_tables: List[tuple] = field(default_factory=list)
     gtable: tuple = ()            # (keys, slot, expire) of the GLOBAL table
     gpending: List[str] = field(default_factory=list)
+    # warm tier (state/tiers.py), when enabled at export: (keys,
+    # {plane: int64[n]}) in canonical absolute form.  Optional npz keys on
+    # the wire — version-1 readers that predate tiers simply ignore them,
+    # and their absence restores as an empty warm store (no version bump).
+    warm: Optional[tuple] = None
 
     def total_keys(self) -> int:
         reg = (sum(len(t[1]) for t in self.native_tables)
@@ -256,6 +261,16 @@ def dumps(snap: ArenaSnapshot) -> bytes:
         arrays["gt_ends"] = ends
         arrays["gt_slot"] = np.asarray(slots, np.int32)
         arrays["gt_expire"] = np.asarray(expires, np.int64)
+    if snap.warm is not None:
+        # warm rows travel int64 canonical regardless of the plane layout:
+        # the store re-encodes per its own epoch on restore, and these rows
+        # are few relative to the arena planes
+        wkeys, wcols = snap.warm
+        blob, ends = _pack_keys(wkeys)
+        arrays["warm_keys"] = blob
+        arrays["warm_ends"] = ends
+        for name in _REG_PLANES:
+            arrays[f"warm_{name}"] = np.asarray(wcols[name], np.int64)
 
     meta = {
         "now": snap.now,
@@ -332,6 +347,11 @@ def loads(data: bytes) -> ArenaSnapshot:
         if "gt_slot" in arrays:
             gtable = (_unpack_keys(arrays["gt_keys"], arrays["gt_ends"]),
                       arrays["gt_slot"], arrays["gt_expire"])
+        warm = None
+        if "warm_ends" in arrays:
+            warm = (_unpack_keys(arrays["warm_keys"], arrays["warm_ends"]),
+                    {name: arrays[f"warm_{name}"].astype(np.int64)
+                     for name in _REG_PLANES})
     except KeyError as e:
         raise SnapshotError(f"snapshot payload missing array {e}") from None
 
@@ -347,6 +367,7 @@ def loads(data: bytes) -> ArenaSnapshot:
         planes=planes, gplanes=gplanes, gcfg=gcfg,
         tables=tables, native_tables=native_tables, gtable=gtable,
         gpending=list(meta.get("gpending", ())),
+        warm=warm,
     )
 
 

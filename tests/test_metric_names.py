@@ -52,6 +52,10 @@ NATIVE_NAMES = (
     "guber_tpu_frontdoor_restarts",
     "guber_tpu_shm_ring_depth",
     "guber_tpu_shm_ring_stalls",
+    # tiered key state (state/tiers.py)
+    "guber_tpu_tier_events_total",
+    "guber_tpu_tier_warm_rows",
+    "guber_tpu_tier_warm_bytes",
 )
 
 

@@ -1,0 +1,441 @@
+"""Tiered key-state suite (state/tiers.py).
+
+The load-bearing property is the bigkey differential: a Zipf stream over a
+large logical namespace served through a deliberately tiny arena + warm
+tier must be BIT-IDENTICAL to an unbounded-arena oracle — including keys
+that demote and later re-promote mid-stream, and keys that demote and
+re-promote within one un-dispatched drain.  Everything else here guards
+the satellites: O(1) SlotTable.stats against a fresh scan, the pinned
+single-tier eviction baseline, version-mismatch snapshot degradation, and
+warm-tier persistence through the snapshot machinery.
+"""
+
+import random
+import struct
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.api.types import Algorithm, RateLimitReq
+from gubernator_tpu.config import TierConfig
+from gubernator_tpu.core.engine import RateLimitEngine, shard_of
+from gubernator_tpu.state.arena import SlotTable
+
+pytestmark = pytest.mark.tiers
+
+T0 = 1_700_000_000_000
+
+
+def _shard0_keys(eng, prefix, n):
+    """Keys all routed to shard 0 — conftest forces an 8-device mesh, so
+    capacity/eviction tests confine their traffic to one table."""
+    out = []
+    i = 0
+    while len(out) < n:
+        k = f"{prefix}:{i}"
+        # the engine routes on hash_key() == name + "_" + unique_key
+        if shard_of(f"r_{k}", eng.num_shards) == 0:
+            out.append(k)
+        i += 1
+    return out
+
+
+def _req(key, limit=10, duration=5_000, hits=1, algo=Algorithm.TOKEN_BUCKET):
+    return RateLimitReq(name="r", unique_key=key, hits=hits, limit=limit,
+                        duration=duration, algorithm=algo)
+
+
+def _tiered_engine(capacity, warm_rows=100_000, layout="int64",
+                   victim_sample=8, epoch=T0, **kw):
+    eng = RateLimitEngine(capacity_per_shard=capacity, batch_per_shard=64,
+                          global_capacity=8, use_native=False, **kw)
+    conf = TierConfig(warm_rows=warm_rows, layout=layout,
+                      victim_sample=victim_sample,
+                      demote_watermark=0.9, demote_batch=32)
+    eng.enable_tiers(conf, epoch=epoch)
+    return eng
+
+
+def _oracle_engine(capacity=8192):
+    return RateLimitEngine(capacity_per_shard=capacity, batch_per_shard=64,
+                           global_capacity=8, use_native=False)
+
+
+def _tuple(r):
+    return (r.status, r.limit, r.remaining, r.reset_time)
+
+
+def _zipf_stream(seed, n_windows, namespace=100_000, s=1.2, max_reqs=16):
+    """Deterministic Zipf-over-2^30-style traffic: a heavy head plus a
+    long tail of one-shot keys, mixed durations and algorithms."""
+    rng = np.random.default_rng(seed)
+    pyr = random.Random(seed)
+    durations = (500, 2_000, 10_000)
+    now = T0
+    for _ in range(n_windows):
+        now += int(rng.integers(1, 60))
+        reqs = []
+        for _ in range(int(rng.integers(1, max_reqs + 1))):
+            k = int(rng.zipf(s)) % namespace
+            algo = (Algorithm.TOKEN_BUCKET if k % 3 else
+                    Algorithm.LEAKY_BUCKET)
+            reqs.append(_req(f"big:{k}", limit=5 + k % 7,
+                             duration=durations[k % 3],
+                             hits=1 + (k % 2), algo=algo))
+        pyr.shuffle(reqs)
+        yield now, reqs
+
+
+# ------------------------------------------------------------ differential
+
+
+@pytest.mark.parametrize("layout", ["int64", "compact32"])
+def test_bigkey_differential_vs_unbounded_oracle(layout):
+    """128 hot slots (16 x 8 shards) over a 100k-key namespace == infinite
+    arena, bit for bit, with demotion/promotion actually exercised."""
+    small = _tiered_engine(16, layout=layout)
+    big = _oracle_engine()
+    for step, (now, reqs) in enumerate(_zipf_stream(11, 400)):
+        got = small.step(reqs, now=now)
+        want = big.step(reqs, now=now)
+        assert [_tuple(a) for a in got] == [_tuple(b) for b in want]
+        if step % 37 == 0:
+            small.tier_maintain(now)
+    st = small.tier_stats()
+    assert st["demotions"] > 0, "arena pressure never spilled a row"
+    assert st["warm_hits"] > 0, "no key ever re-promoted from warm"
+    assert st["pending_spills"] == 0 and st["pending_promotions"] == 0
+    # the oracle really was unbounded
+    assert sum(len(t) for t in big.tables) < 8192
+
+
+def test_differential_demote_repromote_same_drain():
+    """A key evicted and re-requested inside ONE drain must round-trip
+    through the pending-spill short circuit, not the warm store.  Shard-0
+    keys through a 4-slot table, <= 4 distinct keys per window (oracle
+    equivalence holds while the per-drain working set fits the arena), so
+    an old resident evicted early in a window and re-requested later in
+    the same window rides the gather->scatter redirect."""
+    small = _tiered_engine(4)
+    big = _oracle_engine(256)
+    pool = _shard0_keys(small, "sd", 12)
+    rng = random.Random(3)
+    now = T0
+    for _ in range(150):
+        now += rng.randint(1, 40)
+        picks = rng.sample(pool, 3)
+        # a 4th key drawn from the whole pool: over the run it regularly
+        # lands on a key an earlier staging in this SAME window just
+        # evicted, exercising the spill->promotion redirect
+        reqs = [_req(k, duration=3_000)
+                for k in picks + [rng.choice(pool)]]
+        got = small.step(reqs, now=now)
+        want = big.step(reqs, now=now)
+        assert [_tuple(a) for a in got] == [_tuple(b) for b in want]
+    assert small.tier_stats()["promotions_from_spill"] > 0
+
+
+@pytest.mark.parametrize("layout", ["int64", "compact32"])
+def test_bigkey_differential_stacked(layout):
+    """The lockstep stacked path fences once per stack; K windows in one
+    dispatch must still match the oracle exactly."""
+    small = _tiered_engine(16, layout=layout)
+    big = _oracle_engine()
+    stream = _zipf_stream(23, 240, max_reqs=8)
+    windows = list(stream)
+    for i in range(0, len(windows) - 4, 4):
+        now = windows[i][0]
+        stack = [w[1] for w in windows[i:i + 4]]
+        got = small.step_stacked(stack, now=now, k_stack=4)
+        want = big.step_stacked(stack, now=now, k_stack=4)
+        for ga, wa in zip(got, want):
+            assert [_tuple(a) for a in ga] == [_tuple(b) for b in wa]
+    assert small.tier_stats()["demotions"] > 0
+    assert small.tier_stats()["warm_hits"] > 0
+
+
+def test_tiers_on_large_arena_is_noop_and_identical():
+    """With the working set inside the arena, the tiered engine must take
+    zero tier actions and answer byte-identically to a plain engine."""
+    tiered = _tiered_engine(1024)
+    plain = _oracle_engine(1024)
+    for now, reqs in _zipf_stream(5, 120, namespace=300):
+        got = tiered.step(reqs, now=now)
+        want = plain.step(reqs, now=now)
+        assert [_tuple(a) for a in got] == [_tuple(b) for b in want]
+    st = tiered.tier_stats()
+    for k in ("promotions", "demotions", "warm_hits", "warm_evictions"):
+        assert st[k] == 0, f"unexpected tier activity: {k}={st[k]}"
+    assert st["warm_rows"] == 0
+
+
+def test_tiers_disabled_engine_has_no_tier_surface():
+    eng = _oracle_engine(64)
+    assert eng.tier_stats() is None
+    assert eng._tiers is None
+    # default-off config builds a disabled TierConfig
+    assert not TierConfig(warm_rows=0).enabled
+
+
+def test_enable_tiers_rejects_native_and_zero_capacity():
+    eng = _oracle_engine(64)
+    with pytest.raises(ValueError):
+        eng.enable_tiers(TierConfig(warm_rows=0))
+    with pytest.raises(ValueError):
+        TierConfig(warm_rows=16, layout="int16").validate()
+
+
+# ------------------------------------------- satellite: eviction baseline
+
+
+def test_single_tier_eviction_under_pressure_baseline():
+    """Pin today's single-tier behavior: a full arena of LIVE keys evicts
+    the LRU-oldest on overflow, and the evicted key's counters are simply
+    gone — it re-inits from the request config on return."""
+    eng = _oracle_engine(4)
+    ks = _shard0_keys(eng, "p", 5)
+    now = T0
+    # fill shard 0 to capacity, ks[0] oldest
+    for i in range(4):
+        r = eng.step([_req(ks[i], limit=10, duration=60_000)],
+                     now=now + i)[0]
+        assert r.remaining == 9
+    # a 5th live key arrives: ks[0] (LRU-oldest, still live) is evicted
+    assert eng.step([_req(ks[4], limit=10, duration=60_000)],
+                    now=now + 10)[0].remaining == 9
+    # tables key on hash_key() == name + "_" + unique_key
+    assert eng.tables[0].peek(f"r_{ks[0]}") is None
+    assert eng.tables[0].peek(f"r_{ks[4]}") is not None
+    # the survivors kept their counters...
+    assert eng.step([_req(ks[1], limit=10, duration=60_000)],
+                    now=now + 11)[0].remaining == 8
+    # ...but the evicted key lost its history: the client sees a fresh
+    # bucket (remaining 9, not 8) — the correctness cliff tiers remove
+    assert eng.step([_req(ks[0], limit=10, duration=60_000)],
+                    now=now + 12)[0].remaining == 9
+
+
+def test_tiered_eviction_under_pressure_keeps_counters():
+    """Same pressure pattern as the baseline test, with tiers on: the
+    evicted key's counters survive in warm and the client sees the
+    continued bucket."""
+    eng = _tiered_engine(4)
+    ks = _shard0_keys(eng, "p", 5)
+    now = T0
+    for i in range(4):
+        eng.step([_req(ks[i], limit=10, duration=60_000)], now=now + i)
+    eng.step([_req(ks[4], limit=10, duration=60_000)], now=now + 10)
+    assert eng.tables[0].peek(f"r_{ks[0]}") is None  # demoted, not resident
+    assert eng.tier_stats()["demotions"] == 1
+    r = eng.step([_req(ks[0], limit=10, duration=60_000)], now=now + 12)[0]
+    assert r.remaining == 8, "warm promotion must carry the spent hit"
+    assert eng.tier_stats()["warm_hits"] == 1
+
+
+# ------------------------------------------------- satellite: O(1) stats
+
+
+def _scan_stats(t: SlotTable, now: int) -> dict:
+    live = sum(1 for e in t._entries.values() if e[1] >= now)
+    return {"free": t.capacity - len(t._entries), "live": live,
+            "expired": len(t._entries) - live}
+
+
+def test_slottable_stats_incremental_matches_fresh_scan():
+    """Churn a table through lookups/upserts/removes/reclaims with mixed
+    durations and advancing time; the incremental stats must equal a
+    fresh O(capacity) scan at every probe."""
+    rng = random.Random(42)
+    t = SlotTable(64)
+    now = T0
+    for step in range(4_000):
+        now += rng.randint(0, 30)
+        op = rng.random()
+        key = f"k:{rng.randrange(200)}"
+        if op < 0.70:
+            t.lookup(key, now, rng.choice((50, 400, 5_000)))
+        elif op < 0.80:
+            t.upsert(key, now, now + rng.randint(-100, 2_000))
+        elif op < 0.90:
+            t.remove(key)
+        else:
+            t.begin_window()
+            t.commit_window()
+        if step % 17 == 0:
+            assert t.stats(now) == _scan_stats(t, now), f"step {step}"
+    # horizon regression falls back to the scan and stays exact
+    assert t.stats(now - 10_000) == _scan_stats(t, now - 10_000)
+    assert t.stats(now) == _scan_stats(t, now)
+
+
+def test_slottable_stats_expired_preference_survives_stats():
+    """stats() consuming heap nodes must not break _reclaim's
+    expired-first preference (the expired pool hands them over)."""
+    t = SlotTable(4)
+    now = T0
+    for i in range(4):
+        t.lookup(f"k{i}", now, 100)       # all expire at T0+100
+    t.commit_window()
+    late = now + 10_000
+    t.lookup("k0", late, 100)             # refresh k0; k1..k3 now expired
+    st = t.stats(late)
+    assert st == {"free": 0, "live": 1, "expired": 3}
+    # allocation under pressure must reclaim an EXPIRED entry, not LRU
+    t.lookup("fresh", late, 100)
+    assert "k0" in t
+    assert len(t) == 4
+
+
+# ---------------------------------------- satellite: snapshot degradation
+
+
+def test_version_bumped_snapshot_degrades_to_cold_start(tmp_path, caplog):
+    from gubernator_tpu.state import snapshot as snap_mod
+    eng = _oracle_engine(64)
+    eng.step([_req("v:1")], now=T0)
+    blob = snap_mod.dumps(eng.export_state(now=T0 + 1))
+    # bump the format version field (bytes 8:12, after the magic)
+    tampered = (blob[:len(snap_mod.MAGIC)] + struct.pack("<I", 99)
+                + blob[len(snap_mod.MAGIC) + 4:])
+    with pytest.raises(snap_mod.SnapshotError, match="version"):
+        snap_mod.loads(tampered)
+    path = tmp_path / "arena.snap"
+    path.write_bytes(tampered)
+    # boot-path restore: logged cold start, never a raised boot failure
+    fresh = _oracle_engine(64)
+    import logging
+    with caplog.at_level(logging.WARNING, logger="gubernator.snapshot"):
+        assert snap_mod.restore_engine(fresh, str(path)) is None
+    assert any("starting cold" in r.message for r in caplog.records)
+    assert fresh.cache_size == 0
+
+
+# --------------------------------------------- satellite: warm persistence
+
+
+@pytest.mark.parametrize("layout", ["int64", "compact32"])
+def test_warm_tier_snapshot_round_trip(tmp_path, layout):
+    """The warm tier rides the arena snapshot: demoted rows survive a
+    restart and still answer identically to the uninterrupted oracle."""
+    from gubernator_tpu.state import snapshot as snap_mod
+    eng = _tiered_engine(2, layout=layout)
+    oracle = _oracle_engine(256)
+    ks = _shard0_keys(eng, "w", 12)
+    now = T0
+    # 12 shard-0 keys through a 2-slot table: most of them sit warm
+    for k in ks:
+        now += 5
+        eng.step([_req(k, limit=10, duration=120_000)], now=now)
+        oracle.step([_req(k, limit=10, duration=120_000)], now=now)
+    warm_before = eng.tier_stats()["warm_rows"]
+    assert warm_before > 0
+    snap = eng.export_state(now=now)
+    blob = snap_mod.dumps(snap)
+    restored_snap = snap_mod.loads(blob)
+    assert restored_snap.warm is not None
+    assert len(restored_snap.warm[0]) == warm_before
+
+    eng2 = _tiered_engine(2, layout=layout, epoch=now)
+    eng2.import_state(restored_snap, rebase_to=now)
+    assert eng2.tier_stats()["warm_rows"] == warm_before
+    # every key answers as if the process never restarted
+    for k in ks:
+        now += 3
+        got = eng2.step([_req(k, limit=10, duration=120_000)], now=now)[0]
+        want = oracle.step([_req(k, limit=10, duration=120_000)],
+                           now=now)[0]
+        assert _tuple(got) == _tuple(want)
+
+
+def test_warm_rows_into_untiered_engine_drop_with_warning(caplog):
+    import logging
+    eng = _tiered_engine(2)
+    now = T0
+    for k in _shard0_keys(eng, "d", 10):
+        now += 5
+        eng.step([_req(k, duration=60_000)], now=now)
+    snap = eng.export_state(now=now)
+    assert snap.warm is not None and len(snap.warm[0]) > 0
+    plain = _oracle_engine(2)
+    with caplog.at_level(logging.WARNING, logger="gubernator.engine"):
+        plain.import_state(snap)
+    assert any("warm-tier rows" in r.message for r in caplog.records)
+
+
+# --------------------------------------------------------- warm store unit
+
+
+def test_warm_store_overflow_prefers_expired_then_oldest():
+    from gubernator_tpu.state.tiers import WarmStore
+    ws = WarmStore(3, "int64", epoch=T0)
+
+    def row(key, expire):
+        return {"key": key, "limit": 10, "duration": 1000, "remaining": 5,
+                "tstamp": T0, "expire": expire, "algo": 0}
+
+    now = T0 + 500
+    ws.put_batch([row("a", T0 + 100),          # expired by `now`
+                  row("b", T0 + 9_000),
+                  row("c", T0 + 9_000)], now)
+    ws.put_batch([row("d", T0 + 9_000)], now)  # evicts expired "a"
+    assert "a" not in ws and ws.evictions == 1
+    ws.put_batch([row("e", T0 + 9_000)], now)  # no expired left: oldest "b"
+    assert "b" not in ws and "c" in ws and ws.evictions == 2
+
+
+def test_warm_store_compact32_out_of_range_survives_exactly():
+    from gubernator_tpu.state.tiers import WarmStore
+    ws = WarmStore(4, "compact32", epoch=T0)
+    far = T0 + 2 ** 33                          # outside the rebase range
+    row = {"key": "far", "limit": 10, "duration": 1000, "remaining": 5,
+           "tstamp": far - 1000, "expire": far, "algo": 0}
+    ws.put_batch([dict(row)], T0)
+    got = ws.take("far", T0)
+    assert got is not None and not got["rel"]
+    assert got["expire"] == far and got["tstamp"] == far - 1000
+
+
+# ----------------------------------------------------------- config wiring
+
+
+def test_config_from_env_tier_knobs(monkeypatch):
+    from gubernator_tpu.config import config_from_env
+    monkeypatch.setenv("GUBER_TIER_WARM", "4096")
+    monkeypatch.setenv("GUBER_TIER_LAYOUT", "compact32")
+    monkeypatch.setenv("GUBER_TIER_VICTIM_SAMPLE", "4")
+    c = config_from_env()
+    assert c.tiers.enabled and c.tiers.warm_rows == 4096
+    assert c.tiers.layout == "compact32"
+    assert c.tiers.victim_sample == 4
+    # tiers need key strings: the native backend is forced off, loudly
+    assert c.engine.use_native is False
+
+
+def test_config_from_env_tiers_default_off(monkeypatch):
+    from gubernator_tpu.config import config_from_env
+    monkeypatch.delenv("GUBER_TIER_WARM", raising=False)
+    c = config_from_env()
+    assert not c.tiers.enabled
+
+
+# ----------------------------------------------------- observability wiring
+
+
+def test_tier_metrics_exposed_and_advance():
+    from gubernator_tpu.observability.metrics import Metrics
+    m = Metrics()
+    eng = _tiered_engine(4)
+    m.watch_tiers(eng)
+    ks = _shard0_keys(eng, "m", 12)
+    now = T0
+    for k in ks:
+        now += 5
+        eng.step([_req(k, duration=60_000)], now=now)
+    eng.step([_req(ks[0], duration=60_000)], now=now + 5)   # warm hit
+    text = m.expose().decode("utf-8")
+    assert 'guber_tpu_tier_events_total{event="demote"}' in text
+    assert 'guber_tpu_tier_events_total{event="warm_hit"}' in text
+    assert "guber_tpu_tier_warm_rows" in text
+    rows = [ln for ln in text.splitlines()
+            if ln.startswith("guber_tpu_tier_warm_rows ")]
+    assert rows and float(rows[0].split()[1]) > 0
