@@ -106,6 +106,7 @@ bench-smoke:
 	GUBER_PROBE_PLATFORM=cpu GUBER_PROBE_FD_WORKERS=0,2 GUBER_PROBE_SECONDS=2 python scripts/probe_frontdoor.py
 	GUBER_PROBE_PLATFORM=cpu GUBER_PROBE_B=1024 GUBER_PROBE_C=4096 GUBER_PROBE_SECONDS=1 python scripts/probe_chain.py
 	GUBER_PROBE_PLATFORM=cpu GUBER_PROBE_TIER_NS=8192 GUBER_PROBE_TIER_WINDOWS=120 GUBER_PROBE_B=128 python scripts/probe_tiers.py
+	GUBER_PROBE_PLATFORM=cpu GUBER_CLUSTER_NODES=1 GUBER_CLUSTER_SECONDS=2 GUBER_CLUSTER_RATE=20 GUBER_CLUSTER_BATCH=32 GUBER_CLUSTER_FRONTDOOR=2 python scripts/load_cluster.py
 
 docker:
 	docker build -t gubernator-tpu:latest .

@@ -794,6 +794,39 @@ def bench_e2e(mesh, capacity, lanes, seconds=5.0, concurrency=32):
     return asyncio.run(run())
 
 
+def bench_cluster(on_cpu, seconds=3.0):
+    """Multi-node scale-out tier: a 3-node loopback consistent-hash ring
+    under open-loop Zipf load (scripts/load_cluster.py shares the
+    harness).  Reports cluster-aggregate decisions/s, the cross-node
+    forwarding fraction, and the worst node's p99 — the numbers that
+    change when the peer lane or the ring classification regresses,
+    which the single-node tiers cannot see."""
+    import asyncio
+
+    from scripts.load_cluster import run_cluster
+
+    nodes = 3
+    rate = 20.0 if on_cpu else 100.0
+    batch = 32 if on_cpu else 256
+    r = asyncio.run(run_cluster(nodes, seconds, rate, batch,
+                                2_000_000, 1024, 1.2, 0))
+    total = sum(n["decisions"] for n in r["per_node"])
+    wall = max(n["wall"] for n in r["per_node"]) or 1e-9
+    fwd = sum(f["forwarded"] for f in r["forward"])
+    p99 = max(n["p99_ms"] for n in r["per_node"])
+    agg = total / wall
+    fwd_pct = 100.0 * fwd / max(1, total)
+    log(f"# cluster tier: {nodes} nodes, {agg:,.0f} decisions/s "
+        f"aggregate, {fwd_pct:.0f}% forwarded, worst node p99 "
+        f"{p99:.1f}ms")
+    return {
+        "cluster_nodes": nodes,
+        "cluster_decisions_per_sec": round(agg, 1),
+        "cluster_forwarded_pct": round(fwd_pct, 1),
+        "cluster_p99_ms": round(p99, 2),
+    }
+
+
 def bench_pallas_probe(on_cpu):
     """Attempt ONE Pallas-lowered window on the real backend and record
     whether Mosaic accepts it.  Probes the compact32 (rebased int32)
@@ -1166,6 +1199,9 @@ def child_main():
         checkpoint()
 
         tier.update(bench_pallas_probe(on_cpu))
+        checkpoint()
+
+        tier.update(bench_cluster(on_cpu, seconds=2.0 if on_cpu else 5.0))
     except Exception as e:  # noqa: BLE001 — the parent still prints JSON
         import traceback
         traceback.print_exc()

@@ -384,6 +384,15 @@ class DaemonConfig:
     # Slab size; the default fits any max-size (1MB) gRPC message in
     # either record shape (raw bytes, or 1000-item columns + keys).
     shm_slab_bytes: int = (1 << 20) + (1 << 16)
+    # Response-encode side: "worker" ships packed decision columns over
+    # the completion ring and each worker serializes the protobuf in its
+    # own process (native frontdoor_encode_resp / pb fallback); "engine"
+    # restores the classic engine-side serialization.
+    frontdoor_encode: str = "worker"
+    # Wire-read coalescing: up to N pending RPCs per worker event-loop
+    # tick share ONE slab + ONE ring publish (amortizing per-record ring
+    # overhead like the fetch chain amortized device RTT).  0/1 = off.
+    frontdoor_batch_reads: int = 8
 
     # k8s discovery
     k8s_namespace: str = ""
@@ -536,6 +545,10 @@ def config_from_env(env_file: Optional[str] = None) -> DaemonConfig:
                                minimum=2)
     c.shm_slab_bytes = env_int("GUBER_SHM_SLAB_BYTES", c.shm_slab_bytes,
                                minimum=1 << 16)
+    enc = _env("GUBER_FRONTDOOR_ENCODE", c.frontdoor_encode)
+    c.frontdoor_encode = enc if enc in ("worker", "engine") else "worker"
+    c.frontdoor_batch_reads = env_int("GUBER_FRONTDOOR_BATCH_READS",
+                                      c.frontdoor_batch_reads, minimum=0)
 
     c.snapshot_dir = _env("GUBER_SNAPSHOT_DIR")
     c.snapshot_interval_ms = env_int("GUBER_SNAPSHOT_INTERVAL_MS",

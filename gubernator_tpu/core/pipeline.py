@@ -326,16 +326,21 @@ class ColsJob:
     range-falls-back.  Staged like a ListJob (pack_stack_fast over the
     column 6-tuple, zero-copy views into the worker's shm slab) but
     finished like an RpcJob: straight to C-encoded response bytes the hub
-    memcpys back into the slab.  Resolves to bytes, or None when the
-    drain routes it to fallback (the hub then runs the full Python path).
+    memcpys back into the slab — or, with want_cols (worker-side response
+    encode, GUBER_FRONTDOOR_ENCODE=worker), to packed DECISION columns
+    (status, limit, remaining, reset int64 arrays) the hub ships through
+    complete_cols so the WORKER serializes the protobuf instead of the
+    engine.  Resolves to bytes/columns, or None when the drain routes it
+    to fallback (the hub then runs the full Python path).
 
     No _cols slot on purpose: leftover re-queues skip the materialization
     copy because the slab stays valid until the hub completes the record."""
 
     __slots__ = ("cols", "futs", "fut", "row", "lane", "pos", "n",
-                 "ctxs", "enq")
+                 "ctxs", "enq", "want_cols")
 
-    def __init__(self, cols: tuple, n: int, fut: asyncio.Future):
+    def __init__(self, cols: tuple, n: int, fut: asyncio.Future,
+                 want_cols: bool = False):
         self.cols = cols
         self.fut = fut
         self.futs = None
@@ -345,16 +350,43 @@ class ColsJob:
         self.row = None
         self.lane = None
         self.pos = None
+        self.want_cols = want_cols
 
     def columns(self):
         return self.cols
 
-    def finish(self, pipeline, wflat, clflat, now) -> bytes:
-        resp_buf = pipeline._resp_buf(self.n * 64 + 64)
-        m = pipeline.engine.native.fastpath_encode_w(
-            wflat, self.cols[3], now, wflat.shape[-1], self.n,
-            self.row, self.lane, self.pos, resp_buf, climit=clflat)
-        return bytes(resp_buf[:m])
+    def finish(self, pipeline, wflat, clflat, now):
+        if not self.want_cols:
+            resp_buf = pipeline._resp_buf(self.n * 64 + 64)
+            m = pipeline.engine.native.fastpath_encode_w(
+                wflat, self.cols[3], now, wflat.shape[-1], self.n,
+                self.row, self.lane, self.pos, resp_buf, climit=clflat)
+            return bytes(resp_buf[:m])
+        # decision columns: the vectorized decode_word_item (see
+        # ListJob.finish) kept as arrays — no Python response objects,
+        # no serialization; the worker encodes from the completion slab
+        w = wflat[self.row, self.lane]
+        enc = (w >> 32) & 0xFFFFFFFF
+        pos = self.pos
+        synth = pos >= 0
+        p = np.where(synth, pos & 0x3FFFFFFF, 0)
+        algo1 = (pos >> 30) & 1
+        r_start = w & 0x7FFFFFFF
+        under = p < r_start
+        remaining = np.where(
+            synth, np.where(under, r_start - p - 1, 0), w & 0x7FFFFFFF)
+        status = np.where(synth, np.where(under, 0, 1), (w >> 31) & 1)
+        reset = np.where(
+            synth & (algo1 == 1) & under, 0,
+            np.where(enc == 0, 0, now + enc - 1))
+        if clflat is not None:
+            limits = clflat[self.row, self.lane]
+        else:
+            # copy: cols[3] views the shm slab that complete_cols will
+            # overwrite with these very response columns
+            limits = self.cols[3][:self.n].astype(np.int64)
+        return (status.astype(np.int64), limits.astype(np.int64),
+                remaining.astype(np.int64), reset.astype(np.int64))
 
 
 class _GlobalJob:
@@ -709,15 +741,19 @@ class DispatchPipeline:
         self._pump()
         return await fut
 
-    async def submit_cols(self, cols: tuple, n: int) -> Optional[bytes]:
+    async def submit_cols(self, cols: tuple, n: int,
+                          want_cols: bool = False) -> Optional[bytes]:
         """Serve worker-parsed GetRateLimitsReq COLUMNS (the frontdoor shm
         lane): (key_bytes, key_ends, hits, limits, durations, algos) views
         into the worker's slab pack-stack directly — parsed once, in the
-        worker, never re-materialized as Python objects.  None => the hub
-        must run the engine-side Python fallback.  COLS is only sound
-        standalone: pack_stack_fast never consults the ring, so installed
-        peers force the fallback (the hub mirrors this gate into the
-        status block so workers stop sending COLS records at all)."""
+        worker, never re-materialized as Python objects.  With want_cols
+        the job resolves to DECISION columns for a complete_cols
+        completion (worker-side encode) instead of engine-encoded bytes.
+        None => the hub must run the engine-side Python fallback.  COLS
+        is only sound standalone: pack_stack_fast never consults the
+        ring, so installed peers force the fallback (the hub mirrors this
+        gate into the status block so workers stop sending COLS records
+        at all)."""
         if not (self.enabled and self.rpc_enabled
                 and self.engine._compact_enabled) or self._closed:
             return None
@@ -725,7 +761,7 @@ class DispatchPipeline:
             return None
         self._loop = asyncio.get_running_loop()
         fut = self._loop.create_future()
-        job = ColsJob(cols, n, fut)
+        job = ColsJob(cols, n, fut, want_cols=want_cols)
         job.enq = time.monotonic()
         self._jobs.append(job)
         self._pump()
@@ -1221,13 +1257,17 @@ class DispatchPipeline:
         by_owner: dict = {}
         pending: dict = {}
         results: dict = {}
+        n_fwd = 0
         for job in jobs:
             job.forward_task = self._loop.create_future()
             pending[id(job)] = len(job.remote_idx)
             results[id(job)] = {}
+            n_fwd += len(job.remote_idx)
             for i in job.remote_idx.tolist():
                 by_owner.setdefault(-2 - int(job.row[i]),
                                     []).append((job, int(i)))
+        if self.metrics is not None and n_fwd:
+            self.metrics.cluster_forwarded.inc(n_fwd)
 
         def deliver(job, i, frame):
             jid = id(job)

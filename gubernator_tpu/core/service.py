@@ -273,6 +273,7 @@ class Instance:
         # gRPC metadata (net/peers.py), so the owner's peer_rpc span lands
         # in the same trace — one stitched view of the cross-node hit
         t0 = time.monotonic()
+        self.metrics.cluster_forwarded.inc()
         try:
             with self.tracer.span("peer_forward") as span:
                 span.set_attr("peer", peer.host)
@@ -510,6 +511,7 @@ class Instance:
         if self.batcher.pipeline is not None:
             self.batcher.pipeline.rpc_enabled = False
         self._picker = picker
+        self.metrics.cluster_peers.set(picker.size())
         self.health = HealthCheckResp(
             status=UNHEALTHY if errs else HEALTHY,
             message="|".join(errs),

@@ -16,8 +16,12 @@ record's life cycle:
   the slab)  ->  submit(slot): publish the slot index
   engine: pop() the index, read the record (columns are zero-copy numpy
   views into the slab)  ->  serve it  ->  complete(slot, ...): write the
-  response bytes back INTO the same slab + publish a completion entry
-  worker: poll_completions() reads the response, frees the slab
+  response back INTO the same slab + publish a completion entry — either
+  serialized bytes, or (complete_cols) packed DECISION columns that the
+  WORKER encodes to protobuf in its own process, keeping serialization
+  off the single-threaded engine loop entirely
+  worker: poll_completions_raw() reads the response (encoding columnar
+  completions first), then frees the slab
 
 Slot indices travel through the rings; slabs return to the worker's free
 list only via a completion, so the engine may keep a slab's column views
@@ -48,10 +52,30 @@ KIND_TRANSFER = 3     # TransferBuckets payload
 KIND_REGISTER = 4     # serialized RegisterGlobalsReq
 KIND_APPLY_GREG = 5   # serialized ApplyGlobalRegistrationReq
 KIND_UPDATE_GLOBALS = 6  # serialized UpdatePeerGlobalsReq
+KIND_BATCH_COLS = 7   # several coalesced RPCs' columns in ONE slab: the
+#                       per-RPC item counts live in the counts region and
+#                       the columns are the concatenation, so the engine
+#                       stages the whole batch as one pipeline job
 
 # completion status: 0 = OK (payload is response bytes); > 0 = the gRPC
 # status code the worker must abort with (payload is the utf-8 message)
 STATUS_OK = 0
+
+# Completion entries whose LENGTH field is negative carry decision
+# COLUMNS in the slab instead of serialized response bytes: n = -length
+# items at resp_views(slot), and the WORKER encodes the protobuf (native
+# frontdoor_encode_resp or the pb fallback) — the engine never serializes
+# for columnar records.  A flags column value of 0 is a plain decision;
+# nonzero indexes SHED_REASON_CODES (mirrored in host_router.cc
+# SHED_REASONS) and the worker adds qos/admission.py's shed metadata.
+SHED_REASON_CODES = {
+    "queue_full": 1,
+    "deadline": 2,
+    "breaker_open": 3,
+    "draining": 4,
+    "ring_full": 5,
+}
+SHED_CODE_REASONS = {v: k for k, v in SHED_REASON_CODES.items()}
 
 _HDR_I64 = 64          # header int64s (publish counters, cacheline-spread)
 _SUB_TAIL = 0          # worker-written
@@ -61,6 +85,8 @@ _COMP_HEAD = 24        # worker-written
 _REC_HDR = 64          # per-slab record header bytes
 _COLS_BYTES_PER_ITEM = 40  # key_ends+hits+limits+durations (8*4) + algo+name_len (4*2)
 MAX_ITEMS = 1000       # MAX_BATCH_SIZE: the reference's per-RPC cap
+MAX_BATCH_RPCS = 64    # coalesced RPCs per KIND_BATCH_COLS record (the
+#                        counts region is a fixed int64[MAX_BATCH_RPCS])
 
 
 def _align(n: int, a: int = 64) -> int:
@@ -73,7 +99,7 @@ class ShmRecord:
     records carry a bytes copy of the payload."""
 
     __slots__ = ("slot", "kind", "req_id", "deadline", "n", "cols",
-                 "name_lens", "payload")
+                 "name_lens", "payload", "counts")
 
     def __init__(self, slot: int, kind: int, req_id: int, deadline: float):
         self.slot = slot
@@ -84,6 +110,7 @@ class ShmRecord:
         self.cols = None
         self.name_lens = None
         self.payload = b""
+        self.counts = None  # KIND_BATCH_COLS: per-RPC item counts
 
 
 try:  # pragma: no cover - stdlib-version dependent
@@ -154,19 +181,27 @@ class WorkerChannel:
                           self._pool_off + i * slab_bytes)
             for i in range(slots)
         ]
-        # fixed columnar layout inside every slab (COLS records): column
-        # capacity first, the key region takes the rest
+        # fixed columnar layout inside every slab (COLS records): the
+        # batch counts region (per-RPC item counts of a KIND_BATCH_COLS
+        # record; response byte lengths of its bytes-form completion)
+        # sits between the record header and the columns, then column
+        # capacity, and the key region takes the rest.  The RESPONSE
+        # columns of a columnar completion reuse the request columns'
+        # offsets (status/limit/remaining/reset over ke/hi/li/du, flags
+        # over algos) — by completion time the request columns are dead.
+        self._cnt_off = _REC_HDR
+        cols0 = _REC_HDR + 8 * MAX_BATCH_RPCS
         self.cap_items = min(
             MAX_ITEMS,
-            max(0, (slab_bytes - _REC_HDR) // (_COLS_BYTES_PER_ITEM + 8)))
+            max(0, (slab_bytes - cols0) // (_COLS_BYTES_PER_ITEM + 8)))
         c = self.cap_items
-        self._ke_off = _REC_HDR
-        self._hi_off = _REC_HDR + 8 * c
-        self._li_off = _REC_HDR + 16 * c
-        self._du_off = _REC_HDR + 24 * c
-        self._al_off = _REC_HDR + 32 * c
-        self._nl_off = _REC_HDR + 36 * c
-        self._key_off = _REC_HDR + _COLS_BYTES_PER_ITEM * c
+        self._ke_off = cols0
+        self._hi_off = cols0 + 8 * c
+        self._li_off = cols0 + 16 * c
+        self._du_off = cols0 + 24 * c
+        self._al_off = cols0 + 32 * c
+        self._nl_off = cols0 + 36 * c
+        self._key_off = cols0 + _COLS_BYTES_PER_ITEM * c
         self.key_cap = slab_bytes - self._key_off
         # worker-side free list (the worker is the only allocator; slots
         # come back via completions)
@@ -258,6 +293,31 @@ class WorkerChannel:
             np.frombuffer(buf, np.int32, c, base + self._nl_off),
         )
 
+    def counts_view(self, slot: int) -> np.ndarray:
+        """The slab's per-RPC counts region (KIND_BATCH_COLS item counts
+        on the way in; bytes-form completion lengths on the way back)."""
+        buf = self._shm.buf
+        base = self._pool_off + slot * self.slab_bytes
+        return np.frombuffer(buf, np.int64, MAX_BATCH_RPCS,
+                             base + self._cnt_off)
+
+    def resp_views(self, slot: int):
+        """The slab's DECISION columns for a columnar completion:
+        (status, limit, remaining, reset int64[c], flags int32[c]).
+        Written by the engine's complete_cols, read (and encoded) by the
+        worker before the slot is freed; laid over the request columns,
+        which are consumed by then."""
+        buf = self._shm.buf
+        base = self._pool_off + slot * self.slab_bytes
+        c = self.cap_items
+        return (
+            np.frombuffer(buf, np.int64, c, base + self._ke_off),
+            np.frombuffer(buf, np.int64, c, base + self._hi_off),
+            np.frombuffer(buf, np.int64, c, base + self._li_off),
+            np.frombuffer(buf, np.int64, c, base + self._du_off),
+            np.frombuffer(buf, np.int32, c, base + self._al_off),
+        )
+
     def _slab_hdr(self, slot: int) -> np.ndarray:
         buf = self._shm.buf
         return np.frombuffer(buf, np.int64, 8,
@@ -292,6 +352,22 @@ class WorkerChannel:
         hdr[4] = 0
         hdr[5] = np.float64(deadline).view(np.int64)
 
+    def commit_batch(self, slot: int, req_id: int, counts: List[int],
+                     key_len: int, deadline: float = 0.0) -> None:
+        """Header for a KIND_BATCH_COLS record: len(counts) coalesced
+        RPCs whose concatenated columns frontdoor_parse_req wrote into
+        cols_views(slot) (key_ends rebased by the caller); counts[j] is
+        RPC j's item count."""
+        m = len(counts)
+        self.counts_view(slot)[:m] = counts
+        hdr = self._slab_hdr(slot)
+        hdr[0] = KIND_BATCH_COLS
+        hdr[1] = req_id
+        hdr[2] = int(sum(counts))
+        hdr[3] = key_len
+        hdr[4] = m
+        hdr[5] = np.float64(deadline).view(np.int64)
+
     def submit(self, slot: int) -> None:
         """Publish a written record (cannot overflow: the ring holds as
         many entries as there are slabs)."""
@@ -320,6 +396,29 @@ class WorkerChannel:
             self._hdr[_COMP_HEAD] = head
         return out
 
+    def poll_completions_raw(self) -> List[Tuple[int, int, int, int]]:
+        """Drain ready completion ENTRIES without freeing the slabs:
+        [(slot, req_id, status, length)].  length < 0 marks a columnar
+        completion of n = -length decisions at resp_views(slot); the
+        caller encodes (worker-side response encode) while it still owns
+        the slab, then free_slot()s it."""
+        out = []
+        head = int(self._hdr[_COMP_HEAD])
+        tail = int(self._hdr[_COMP_TAIL])
+        while head < tail:
+            e = (head % self.slots) * 4
+            out.append((int(self._comp[e]), int(self._comp[e + 1]),
+                        int(self._comp[e + 2]), int(self._comp[e + 3])))
+            head += 1
+        if out:
+            self._hdr[_COMP_HEAD] = head
+        return out
+
+    def free_slot(self, slot: int) -> None:
+        """Return a completed slab to the free list (worker side), after
+        the response bytes/columns have been consumed."""
+        self._free.append(slot)
+
     # ------------------------------------------------------- engine consumer
 
     def sub_depth(self) -> int:
@@ -343,7 +442,7 @@ class WorkerChannel:
             rec = ShmRecord(
                 slot=slot, kind=kind, req_id=int(hdr[1]),
                 deadline=float(np.int64(hdr[5]).view(np.float64)))
-            if kind == KIND_COLS:
+            if kind in (KIND_COLS, KIND_BATCH_COLS):
                 n = int(hdr[2])
                 key_len = int(hdr[3])
                 kb, ke, hi, li, du, al, nl = self.cols_views(slot)
@@ -351,6 +450,9 @@ class WorkerChannel:
                             al[:n])
                 rec.name_lens = nl[:n]
                 rec.n = n
+                if kind == KIND_BATCH_COLS:
+                    m = int(hdr[4])
+                    rec.counts = [int(x) for x in self.counts_view(slot)[:m]]
             else:
                 rec.payload = bytes(self._slabs[slot][
                     _REC_HDR:_REC_HDR + int(hdr[2])])
@@ -376,6 +478,61 @@ class WorkerChannel:
         self._comp[e + 3] = len(payload)
         self._hdr[_COMP_TAIL] = tail + 1  # publish last
 
+    def complete_cols(self, slot: int, req_id: int, status, limit,
+                      remaining, reset, flags=None) -> None:
+        """Columnar completion: write the DECISION columns into the slab
+        and publish length = -n — the worker encodes the protobuf in its
+        own process (native frontdoor_encode_resp or the pb fallback).
+        For KIND_BATCH_COLS records the request's counts region still
+        holds the per-RPC split.  flags is None (all plain) or an int32
+        column of SHED_REASON_CODES values."""
+        n = len(status)
+        st, li, re, rs, fl = self.resp_views(slot)
+        st[:n] = status
+        li[:n] = limit
+        re[:n] = remaining
+        rs[:n] = reset
+        fl[:n] = 0 if flags is None else flags
+        tail = int(self._hdr[_COMP_TAIL])
+        e = (tail % self.slots) * 4
+        self._comp[e] = slot
+        self._comp[e + 1] = req_id
+        self._comp[e + 2] = STATUS_OK
+        self._comp[e + 3] = -n
+        self._hdr[_COMP_TAIL] = tail + 1  # publish last
+
+    def complete_batch_bytes(self, slot: int, req_id: int,
+                             parts: List[bytes]) -> None:
+        """Bytes-form completion of a KIND_BATCH_COLS record (the rare
+        fallback when a sub-response cannot be expressed as columns):
+        per-RPC serialized responses concatenated after the counts
+        region, with the split lengths written over it.  Oversized
+        payloads degrade like complete()."""
+        total = sum(len(p) for p in parts)
+        if total > self.slab_bytes - self._ke_off:
+            self.complete(slot, req_id, 8, b"response exceeds shm slab")
+            return
+        cnt = self.counts_view(slot)
+        off = self._ke_off
+        slab = self._slabs[slot]
+        for j, p in enumerate(parts):
+            cnt[j] = len(p)
+            slab[off:off + len(p)] = np.frombuffer(p, np.uint8)
+            off += len(p)
+        tail = int(self._hdr[_COMP_TAIL])
+        e = (tail % self.slots) * 4
+        self._comp[e] = slot
+        self._comp[e + 1] = req_id
+        self._comp[e + 2] = STATUS_OK
+        self._comp[e + 3] = total
+        self._hdr[_COMP_TAIL] = tail + 1  # publish last
+
+    def batch_payload(self, slot: int, m: int, total: int):
+        """Worker-side read of a bytes-form batch completion: the per-RPC
+        lengths and a view of the concatenated payload."""
+        lengths = [int(x) for x in self.counts_view(slot)[:m]]
+        return lengths, self._slabs[slot][self._ke_off:self._ke_off + total]
+
 
 # ---------------------------------------------------------------- status block
 
@@ -385,10 +542,10 @@ FLAG_COLS_OK = 1 << 2     # engine accepts KIND_COLS (standalone + compact)
 
 _MSG_CAP = 256
 _W_ROW0 = 16              # per-worker rows start at this int64 index
-_W_STRIDE = 8
+_W_STRIDE = 12
 # per-worker row fields; single writer per FIELD: the engine owns pid /
 # epoch / restarts, the worker owns port / rpcs / sheds / healthchecks /
-# stalls
+# stalls / encodes / enc_fallbacks / batch_rpcs / batch_flushes
 W_PID = 0
 W_PORT = 1
 W_EPOCH = 2
@@ -397,6 +554,10 @@ W_RPCS = 4
 W_SHEDS = 5
 W_HEALTHCHECKS = 6
 W_STALLS = 7
+W_ENCODES = 8        # responses the worker encoded from decision columns
+W_ENC_FALLBACK = 9   # completions that arrived as engine-encoded bytes
+W_BATCH_RPCS = 10    # RPCs that rode a coalesced KIND_BATCH_COLS record
+W_BATCH_FLUSHES = 11  # multi-RPC batch publishes (single ring entries)
 
 
 class FrontdoorStatus:

@@ -166,7 +166,9 @@ class Daemon:
             self.frontdoor = FrontdoorHub(
                 self.instance, workers=c.frontdoor_workers,
                 ring_slots=c.shm_ring_slots, slab_bytes=c.shm_slab_bytes,
-                listen_address=c.grpc_listen_address)
+                listen_address=c.grpc_listen_address,
+                encode=c.frontdoor_encode,
+                batch_reads=c.frontdoor_batch_reads)
             await self.frontdoor.start()
             # surfaced in /v1/admin/debug + metrics like any subsystem
             self.instance.frontdoor = self.frontdoor

@@ -21,6 +21,19 @@ frontend WORKER processes and the one ENGINE process:
     PeersV1 plane) ships as RAW bytes and runs LITERALLY the same
     server.py serve_* coroutines the single-process servicers run —
     byte-identical decisions and responses by construction;
+  * the RESPONSE direction mirrors the request one
+    (GUBER_FRONTDOOR_ENCODE=worker, the default): the engine's completion
+    writes packed DECISION columns (status/limit/remaining/reset + shed
+    flag) into the completion-ring slab and each WORKER serializes the
+    protobuf in its own process (native frontdoor_encode_resp, pb
+    fallback) — protobuf encode never runs on the engine loop, for COLS
+    and RAW/shed GetRateLimits paths alike.  Responses that cannot be
+    expressed as columns (error strings, exotic metadata) fall back to
+    engine-side serialization, counted in encode_fallbacks;
+  * workers coalesce wire reads (GUBER_FRONTDOOR_BATCH_READS): RPCs that
+    land in the same event-loop tick parse into ONE slab as a
+    KIND_BATCH_COLS record — one ring publish, one pipeline job — and
+    the completion columns split back per-RPC by the counts region;
   * workers answer HealthCheck locally from the engine-heartbeated
     status block (a health probe never queues behind a saturated engine
     loop) and shed in-band — no cross-process round-trip — on the shared
@@ -49,18 +62,24 @@ from typing import Dict, List, Optional
 
 import grpc
 
+import numpy as np
+
 from gubernator_tpu.core import shm_ring
 from gubernator_tpu.core.shm_ring import (
     FLAG_COLS_OK,
     FLAG_DRAINING,
     FLAG_SATURATED,
     KIND_APPLY_GREG,
+    KIND_BATCH_COLS,
     KIND_COLS,
     KIND_PEER_RL,
     KIND_RAW,
     KIND_REGISTER,
     KIND_TRANSFER,
     KIND_UPDATE_GLOBALS,
+    MAX_BATCH_RPCS,
+    SHED_CODE_REASONS,
+    SHED_REASON_CODES,
     FrontdoorStatus,
     WorkerChannel,
 )
@@ -110,20 +129,60 @@ class _Worker:
     child; never imports the engine)."""
 
     def __init__(self, worker_id: int, chan: WorkerChannel,
-                 status: FrontdoorStatus, fastpath_min: int):
+                 status: FrontdoorStatus, fastpath_min: int,
+                 encode_mode: str = "worker", batch_reads: int = 8):
         self.worker_id = worker_id
         self.chan = chan
         self.status = status
         self.fastpath_min = fastpath_min
+        self.encode_mode = encode_mode
+        # coalescing implies worker-side encode: a batch completion is
+        # columnar (or per-RPC parts), never one engine-encoded buffer
+        self.batch_reads = batch_reads if encode_mode == "worker" else 0
         from gubernator_tpu import native
+        from gubernator_tpu.api import pb, types
         self.native = native
         self.native_ok = native.available()
+        self.pb = pb
+        self.types = types
         self._req_id = 0
         self._waiters: Dict[int, asyncio.Future] = {}
+        self._batches: Dict[int, tuple] = {}  # rid -> (futs, counts)
+        self._pending: List[tuple] = []       # (data, fut, deadline)
+        self._ebuf: Optional[np.ndarray] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
 
     def _bump(self, field: int, n: int = 1) -> None:
         self.status.bump_w(self.worker_id, field, n)
+
+    # -------------------------------------------------------- response encode
+
+    def encode_cols(self, st, li, re, rs, fl, off: int, n: int) -> bytes:
+        """Serialize n decisions starting at column offset `off` — the
+        worker-side response encode.  Native lane first (byte-compatible
+        with the engine's fastpath_encode_w), pb objects as the fallback
+        (byte-identical to the classic engine serialization, same
+        runtime)."""
+        if self.native_ok:
+            need = n * 96 + 64
+            if self._ebuf is None or self._ebuf.nbytes < need:
+                self._ebuf = np.empty(max(need, 1 << 16), np.uint8)
+            m = self.native.frontdoor_encode_resp(
+                st[off:off + n], li[off:off + n], re[off:off + n],
+                rs[off:off + n], fl[off:off + n], n, self._ebuf)
+            if m >= 0:
+                return bytes(self._ebuf[:m])
+        pb, types = self.pb, self.types
+        resps = []
+        for i in range(off, off + n):
+            code = int(fl[i])
+            md = ({"shed": "true", "shed_reason": SHED_CODE_REASONS[code]}
+                  if code else {})
+            resps.append(types.RateLimitResp(
+                status=int(st[i]), limit=int(li[i]),
+                remaining=int(re[i]), reset_time=int(rs[i]), metadata=md))
+        return pb.GetRateLimitsResp(responses=[
+            pb.resp_to_pb(r) for r in resps]).SerializeToString()
 
     # ------------------------------------------------------------- transport
 
@@ -145,17 +204,130 @@ class _Worker:
         return payload
 
     async def poll_loop(self) -> None:
-        """Completion pump: the only consumer of the completion ring."""
+        """Completion pump: the only consumer of the completion ring.
+        Columnar completions (length < 0) are ENCODED here, while the
+        worker still owns the slab; the slot is freed only after its
+        response has been materialized."""
         while True:
-            comps = self.chan.poll_completions()
+            comps = self.chan.poll_completions_raw()
             if comps:
-                for req_id, status, payload in comps:
-                    fut = self._waiters.get(req_id)
-                    if fut is not None and not fut.done():
-                        fut.set_result((status, payload))
+                for slot, req_id, status, length in comps:
+                    try:
+                        self._deliver(slot, req_id, status, length)
+                    finally:
+                        self.chan.free_slot(slot)
                 await asyncio.sleep(0)
             else:
                 await asyncio.sleep(0.0005)
+
+    def _deliver(self, slot: int, req_id: int, status: int,
+                 length: int) -> None:
+        batch = self._batches.pop(req_id, None)
+        if batch is not None:
+            self._deliver_batch(batch, slot, status, length)
+            return
+        fut = self._waiters.pop(req_id, None)
+        if fut is None or fut.done():
+            return
+        if length < 0:  # decision columns: worker-side encode
+            n = -length
+            st, li, re, rs, fl = self.chan.resp_views(slot)
+            payload = self.encode_cols(st, li, re, rs, fl, 0, n)
+            self._bump(shm_ring.W_ENCODES)
+            fut.set_result((0, payload))
+        else:
+            if status == 0:
+                self._bump(shm_ring.W_ENC_FALLBACK)
+            fut.set_result((status, bytes(self.chan.slab(slot)[:length])))
+
+    def _deliver_batch(self, batch: tuple, slot: int, status: int,
+                       length: int) -> None:
+        futs, counts = batch
+        if status != 0:  # abort fans out to every coalesced RPC
+            payload = bytes(self.chan.slab(slot)[:length])
+            for f in futs:
+                if not f.done():
+                    f.set_result((status, payload))
+            return
+        if length < 0:  # concatenated decision columns, split by counts
+            st, li, re, rs, fl = self.chan.resp_views(slot)
+            off = 0
+            for f, cnt in zip(futs, counts):
+                payload = self.encode_cols(st, li, re, rs, fl, off, cnt)
+                off += cnt
+                self._bump(shm_ring.W_ENCODES)
+                if not f.done():
+                    f.set_result((0, payload))
+        else:  # bytes-form fallback: per-RPC serialized parts
+            lengths, view = self.chan.batch_payload(slot, len(futs), length)
+            off = 0
+            for f, ln in zip(futs, lengths):
+                payload = bytes(view[off:off + ln])
+                off += ln
+                self._bump(shm_ring.W_ENC_FALLBACK)
+                if not f.done():
+                    f.set_result((0, payload))
+
+    def flush_batch(self) -> None:
+        """Coalesce this tick's pending GetRateLimits RPCs into ONE
+        KIND_BATCH_COLS slab + ONE ring publish.  RPCs the C parser
+        rejects (or that overflow the slab) resolve to None and rerun
+        the classic single-record path in their handler."""
+        pending = self._pending
+        self._pending = []
+        if not pending:
+            return
+        if len(pending) == 1:  # nothing to amortize
+            if not pending[0][1].done():
+                pending[0][1].set_result(None)
+            return
+        slot = self.chan.alloc()
+        if slot is None:  # handlers shed ring_full on their own alloc
+            for _, fut, _ in pending:
+                if not fut.done():
+                    fut.set_result(None)
+            return
+        kb, ke, hi, li, du, al, nl = self.chan.cols_views(slot)
+        counts: List[int] = []
+        futs: List[asyncio.Future] = []
+        singles: List[asyncio.Future] = []
+        base, koff = 0, 0
+        dmin = 0.0
+        for data, fut, deadline in pending:
+            n = -1
+            if base < self.chan.cap_items and len(counts) < MAX_BATCH_RPCS:
+                n = self.native.frontdoor_parse_req(
+                    data, kb[koff:], ke[base:], hi[base:], li[base:],
+                    du[base:], al[base:], nl[base:],
+                    self.chan.cap_items - base)
+            if n <= 0:
+                singles.append(fut)
+                continue
+            if koff:
+                ke[base:base + n] += koff
+            koff = int(ke[base + n - 1])
+            base += n
+            counts.append(n)
+            futs.append(fut)
+            if deadline and (dmin == 0.0 or deadline < dmin):
+                dmin = deadline
+        if not counts:
+            self.chan.unalloc(slot)
+        elif len(counts) == 1:  # degenerate: a plain COLS record
+            rid = self.next_id()
+            self.chan.commit_cols(slot, rid, counts[0], koff, dmin)
+            self._waiters[rid] = futs[0]
+            self.chan.submit(slot)
+        else:
+            rid = self.next_id()
+            self.chan.commit_batch(slot, rid, counts, koff, dmin)
+            self._batches[rid] = (futs, counts)
+            self._bump(shm_ring.W_BATCH_FLUSHES)
+            self._bump(shm_ring.W_BATCH_RPCS, len(counts))
+            self.chan.submit(slot)
+        for fut in singles:
+            if not fut.done():
+                fut.set_result(None)
 
     def next_id(self) -> int:
         self._req_id += 1
@@ -189,11 +361,13 @@ class _WorkerV1:
         st = w.status
         reason = None
         slot = None
+        use_batch = (w.batch_reads > 1 and w.native_ok
+                     and st.flag(FLAG_COLS_OK))
         if st.flag(FLAG_DRAINING):
             reason = SHED_DRAINING
         elif st.flag(FLAG_SATURATED):
             reason = SHED_QUEUE_FULL
-        else:
+        elif not use_batch:  # batching defers alloc to the flush
             slot = w.chan.alloc()
             if slot is None:
                 # every slab in flight: the producer-side stall signal
@@ -211,6 +385,36 @@ class _WorkerV1:
             rem = tr()
             if rem is not None:
                 deadline = time.monotonic() + rem
+        if use_batch:
+            # batched wire reads: park this RPC for the tick's flush —
+            # RPCs of any size coalesce into one slab + one publish (the
+            # COLS size floor does not apply: a batch of small RPCs IS a
+            # big columnar record).  None = the parser rejected it (or
+            # the batch filled); rerun the classic single path below.
+            fut = w._loop.create_future()
+            w._pending.append((data, fut, deadline))
+            if len(w._pending) == 1:
+                w._loop.call_soon(w.flush_batch)
+            elif len(w._pending) >= min(w.batch_reads, MAX_BATCH_RPCS):
+                w.flush_batch()
+            res = await fut
+            if res is not None:
+                status, payload = res
+                if status != 0:
+                    await context.abort(
+                        _CODE_BY_VALUE.get(status,
+                                           grpc.StatusCode.INTERNAL),
+                        payload.decode("utf-8", "replace"))
+                w._bump(shm_ring.W_RPCS)
+                return payload
+            slot = w.chan.alloc()
+            if slot is None:
+                w._bump(shm_ring.W_STALLS)
+                out = w.shed_bytes(self.pb, data, SHED_RING_FULL)
+                if out is None:
+                    await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                        "malformed GetRateLimitsReq")
+                return out
         rid = w.next_id()
         if (w.native_ok and st.flag(FLAG_COLS_OK)
                 and len(data) >= w.fastpath_min):
@@ -294,13 +498,15 @@ class _WorkerPeers:
 
 async def _worker_amain(worker_id: int, prefix: str, slots: int,
                         slab_bytes: int, listen_host: str, port_hint: int,
-                        fastpath_min: int) -> None:
+                        fastpath_min: int, encode_mode: str = "worker",
+                        batch_reads: int = 8) -> None:
     from gubernator_tpu.api.grpc_api import (add_peers_servicer,
                                              add_v1_servicer)
     chan = WorkerChannel.attach(f"{prefix}_r{worker_id}", slots, slab_bytes)
     status = FrontdoorStatus.attach(f"{prefix}_st",
                                     workers=port_hint_workers(prefix))
-    w = _Worker(worker_id, chan, status, fastpath_min)
+    w = _Worker(worker_id, chan, status, fastpath_min,
+                encode_mode=encode_mode, batch_reads=batch_reads)
     w._loop = asyncio.get_running_loop()
 
     server = grpc.aio.server(options=[
@@ -362,7 +568,8 @@ def port_hint_workers(prefix: str) -> int:
 
 
 def worker_main(worker_id: int, prefix: str, slots: int, slab_bytes: int,
-                listen_host: str, port_hint: int, fastpath_min: int) -> None:
+                listen_host: str, port_hint: int, fastpath_min: int,
+                encode_mode: str = "worker", batch_reads: int = 8) -> None:
     """Spawn entry point (multiprocessing 'spawn' context).  The package
     __init__ imported jax; pin this process to the CPU platform before
     anything could lazily initialize a backend — the accelerator belongs
@@ -374,10 +581,40 @@ def worker_main(worker_id: int, prefix: str, slots: int, slab_bytes: int,
         pass
     logging.basicConfig(level=logging.INFO)
     asyncio.run(_worker_amain(worker_id, prefix, slots, slab_bytes,
-                              listen_host, port_hint, fastpath_min))
+                              listen_host, port_hint, fastpath_min,
+                              encode_mode, batch_reads))
 
 
 # ============================================================ engine process
+
+
+def columnify_resps(resps):
+    """Pack a list of RateLimitResp into decision columns for a
+    complete_cols completion (worker-side response encode), or None when
+    any response cannot be expressed as columns — an error string, or
+    metadata other than exactly qos/admission.py's shed shape — in which
+    case the hub serializes engine-side (counted in encode_fallbacks)."""
+    n = len(resps)
+    st = np.empty(n, np.int64)
+    li = np.empty(n, np.int64)
+    re = np.empty(n, np.int64)
+    rs = np.empty(n, np.int64)
+    fl = np.zeros(n, np.int32)
+    for i, r in enumerate(resps):
+        if r.error:
+            return None
+        md = r.metadata
+        if md:
+            code = (SHED_REASON_CODES.get(md.get("shed_reason", ""))
+                    if len(md) == 2 and md.get("shed") == "true" else None)
+            if code is None:
+                return None
+            fl[i] = code
+        st[i] = r.status
+        li[i] = r.limit
+        re[i] = r.remaining
+        rs[i] = r.reset_time
+    return st, li, re, rs, fl
 
 
 class FrontdoorHub:
@@ -387,11 +624,17 @@ class FrontdoorHub:
     server.py serve_* bodies the single-process servicers use."""
 
     def __init__(self, instance, workers: int, ring_slots: int,
-                 slab_bytes: int, listen_address: str):
+                 slab_bytes: int, listen_address: str,
+                 encode: str = "worker", batch_reads: int = 8):
         self.instance = instance
         self.workers = workers
         self.ring_slots = ring_slots
         self.slab_bytes = slab_bytes
+        self.encode = encode if encode in ("worker", "engine") else "worker"
+        self.batch_reads = batch_reads
+        # responses that could NOT be columnified (error strings, exotic
+        # metadata) and fell back to engine-side serialization
+        self.encode_fallbacks = 0
         host, _, port = listen_address.rpartition(":")
         self._listen_host = host or "localhost"
         self._port_hint = int(port or 0)
@@ -423,7 +666,7 @@ class FrontdoorHub:
                   # after the first bind, respawns must re-claim the SAME
                   # public port (an ephemeral hint of 0 would move it)
                   self._listen_host, self.port or self._port_hint,
-                  FASTPATH_MIN_BYTES),
+                  FASTPATH_MIN_BYTES, self.encode, self.batch_reads),
             daemon=True)
         p.start()
         self.procs[i] = p
@@ -592,19 +835,40 @@ class FrontdoorHub:
             payload = str(e).encode()
         self.records_served += 1
         # epoch guard: after a crash-restart the slot belongs to the NEW
-        # worker's free pool — a stale completion must not touch it
-        if self.epochs[wid] == epoch:
-            self.chans[wid].complete(rec.slot, rec.req_id, status, payload)
+        # worker's free pool — a stale completion (bytes OR columns) must
+        # not touch it: the respawned worker would otherwise encode a
+        # dead epoch's decisions against a recycled slab
+        if self.epochs[wid] != epoch:
+            return
+        ch = self.chans[wid]
+        if status == 0 and isinstance(payload, tuple):
+            if payload[0] == "cols":  # worker-side encode
+                st, li, re, rs, fl = payload[1]
+                ch.complete_cols(rec.slot, rec.req_id, st, li, re, rs, fl)
+            else:  # "bparts": per-RPC serialized parts of a batch
+                ch.complete_batch_bytes(rec.slot, rec.req_id, payload[1])
+        else:
+            ch.complete(rec.slot, rec.req_id, status, payload)
 
-    async def _dispatch(self, rec) -> bytes:
+    async def _dispatch(self, rec):
         from gubernator_tpu import server as srv
         from gubernator_tpu.api import pb
         inst = self.instance
         ctx = _EngineContext(rec.deadline)
         if rec.kind == KIND_COLS:
             return await self._serve_cols(rec, ctx)
+        if rec.kind == KIND_BATCH_COLS:
+            return await self._serve_batch(rec, ctx)
         if rec.kind == KIND_RAW:
-            return await srv.serve_get_rate_limits(inst, rec.payload, ctx)
+            # ONE code path for the response direction: the inner body
+            # returns resps from the Python path, and worker-encode mode
+            # ships them as columns just like the COLS lane — small and
+            # exotic requests no longer fall back to engine serialization
+            kind, val = await srv.serve_get_rate_limits_inner(
+                inst, rec.payload, ctx)
+            if kind == "bytes":
+                return val
+            return self._finish_resps(val)
         if rec.kind == KIND_PEER_RL:
             return await srv.serve_peer_rate_limits(inst, rec.payload, ctx)
         if rec.kind == KIND_TRANSFER:
@@ -624,27 +888,37 @@ class FrontdoorHub:
         raise FrontdoorAbort(grpc.StatusCode.UNIMPLEMENTED,
                              f"unknown frontdoor record kind {rec.kind}")
 
-    async def _serve_cols(self, rec, ctx: _EngineContext) -> bytes:
+    async def _serve_cols(self, rec, ctx: _EngineContext):
         """Worker-parsed columns: the mirror of serve_get_rate_limits with
         the C parse already done.  The columns passed frontdoor_parse_req's
         acceptance rules — exactly the native lane's — so the pipeline
         never range-falls-back on them; the Python fallback below only
         runs on saturation or a pipeline/membership gate, and reconstructs
         the requests exactly (name_lens splits each assembled hash key)."""
-        from gubernator_tpu.api import pb
-        from gubernator_tpu.api.types import RateLimitReq
-        from gubernator_tpu.core.service import BatchTooLargeError
         inst = self.instance
         m = inst.metrics
         start = time.monotonic()
+        want_cols = self.encode == "worker"
         qos_saturated = (inst.qos is not None
                          and inst.qos.admission.saturated)
         if not qos_saturated:
-            out = await inst.batcher.submit_cols(rec.cols, rec.n)
+            out = await inst.batcher.submit_cols(rec.cols, rec.n,
+                                                 want_cols=want_cols)
             if out is not None:
                 m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start,
                               ok=True)
+                if want_cols:  # (status, limit, remaining, reset) arrays
+                    return ("cols", (*out, None))
                 return out
+        resps = await self._py_fallback(rec, ctx, m, start)
+        return self._finish_resps(resps)
+
+    async def _py_fallback(self, rec, ctx: _EngineContext, m, start):
+        """Reconstruct the record's requests from its columns and run the
+        engine's full Python path (shared by COLS and BATCH fallbacks)."""
+        from gubernator_tpu.api.types import RateLimitReq
+        from gubernator_tpu.core.service import BatchTooLargeError
+        inst = self.instance
         kb, ke, hits, limits, durations, algos = rec.cols
         key_all = bytes(kb)
         reqs = []
@@ -668,6 +942,54 @@ class FrontdoorHub:
             m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=False)
             raise FrontdoorAbort(grpc.StatusCode.OUT_OF_RANGE, str(e))
         m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=True)
+        return resps
+
+    async def _serve_batch(self, rec, ctx: _EngineContext):
+        """A KIND_BATCH_COLS record: several coalesced RPCs' columns as
+        ONE pipeline job, completed as ONE columnar entry the worker
+        splits back per-RPC by the counts region.  Batches only exist in
+        worker-encode mode, so the completion is columns (or per-RPC
+        bytes parts on the rare non-columnifiable fallback)."""
+        inst = self.instance
+        m = inst.metrics
+        start = time.monotonic()
+        qos_saturated = (inst.qos is not None
+                         and inst.qos.admission.saturated)
+        if not qos_saturated:
+            out = await inst.batcher.submit_cols(rec.cols, rec.n,
+                                                 want_cols=True)
+            if out is not None:
+                for _ in rec.counts:
+                    m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start,
+                                  ok=True)
+                return ("cols", (*out, None))
+        resps = await self._py_fallback(rec, ctx, m, start)
+        cols = columnify_resps(resps)
+        if cols is not None:
+            return ("cols", cols)
+        # per-RPC serialized parts: split the responses by the request
+        # counts so every coalesced RPC still gets ITS response
+        from gubernator_tpu.api import pb
+        parts = []
+        off = 0
+        for cnt in rec.counts:
+            parts.append(pb.GetRateLimitsResp(responses=[
+                pb.resp_to_pb(r) for r in resps[off:off + cnt]
+            ]).SerializeToString())
+            off += cnt
+        self.encode_fallbacks += 1
+        return ("bparts", parts)
+
+    def _finish_resps(self, resps):
+        """The response-direction tail shared by every GetRateLimits
+        fallback: columnify for worker-side encode, or (engine mode /
+        non-columnifiable responses) serialize here and count it."""
+        from gubernator_tpu.api import pb
+        if self.encode == "worker":
+            cols = columnify_resps(resps)
+            if cols is not None:
+                return ("cols", cols)
+            self.encode_fallbacks += 1
         return pb.GetRateLimitsResp(
             responses=[pb.resp_to_pb(r) for r in resps]).SerializeToString()
 
@@ -677,7 +999,9 @@ class FrontdoorHub:
         """Aggregates for the metrics scrape hook (watch_frontdoor)."""
         s = {"workers": self.workers, "restarts": self.restarts,
              "rpcs": 0, "sheds": 0, "healthchecks": 0, "stalls": 0,
-             "depth": 0, "inflight": 0}
+             "depth": 0, "inflight": 0, "encodes": 0, "enc_fallbacks": 0,
+             "batch_rpcs": 0, "batch_flushes": 0,
+             "engine_encode_fallbacks": self.encode_fallbacks}
         if self.status is None:
             return s
         for i in range(self.workers):
@@ -685,6 +1009,12 @@ class FrontdoorHub:
             s["sheds"] += self.status.get_w(i, shm_ring.W_SHEDS)
             s["healthchecks"] += self.status.get_w(i, shm_ring.W_HEALTHCHECKS)
             s["stalls"] += self.status.get_w(i, shm_ring.W_STALLS)
+            s["encodes"] += self.status.get_w(i, shm_ring.W_ENCODES)
+            s["enc_fallbacks"] += self.status.get_w(i,
+                                                    shm_ring.W_ENC_FALLBACK)
+            s["batch_rpcs"] += self.status.get_w(i, shm_ring.W_BATCH_RPCS)
+            s["batch_flushes"] += self.status.get_w(i,
+                                                    shm_ring.W_BATCH_FLUSHES)
         for ch in self.chans:
             s["depth"] += ch.sub_depth()
             s["inflight"] += ch.inflight()
@@ -704,6 +1034,12 @@ class FrontdoorHub:
                 "sheds": self.status.get_w(i, shm_ring.W_SHEDS),
                 "healthchecks": self.status.get_w(i, shm_ring.W_HEALTHCHECKS),
                 "stalls": self.status.get_w(i, shm_ring.W_STALLS),
+                "encodes": self.status.get_w(i, shm_ring.W_ENCODES),
+                "enc_fallbacks": self.status.get_w(i,
+                                                   shm_ring.W_ENC_FALLBACK),
+                "batch_rpcs": self.status.get_w(i, shm_ring.W_BATCH_RPCS),
+                "batch_flushes": self.status.get_w(i,
+                                                   shm_ring.W_BATCH_FLUSHES),
                 "ring_depth": self.chans[i].sub_depth() if self.chans else 0,
                 "inflight": self.chans[i].inflight() if self.chans else 0,
             })
@@ -717,5 +1053,8 @@ class FrontdoorHub:
             "slab_bytes": self.slab_bytes,
             "restarts": self.restarts,
             "records_served": self.records_served,
+            "encode_mode": self.encode,
+            "batch_reads": self.batch_reads,
+            "engine_encode_fallbacks": self.encode_fallbacks,
             "per_worker": rows,
         }

@@ -106,6 +106,11 @@ def _load() -> Optional[ctypes.CDLL]:
             u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             u8p, i64p, i64p, i64p, i64p, i32p, i32p,
         ]
+        lib.frontdoor_encode_resp.restype = ctypes.c_int64
+        lib.frontdoor_encode_resp.argtypes = [
+            i64p, i64p, i64p, i64p, i32p, ctypes.c_int64,
+            u8p, ctypes.c_int64,
+        ]
         u64p = ctypes.POINTER(ctypes.c_uint64)
         lib.router_export_keys.restype = ctypes.c_int64
         lib.router_export_keys.argtypes = [
@@ -154,6 +159,27 @@ def frontdoor_parse_req(data: bytes, key_bytes: np.ndarray,
         _ptr(hits, ctypes.c_int64), _ptr(limits, ctypes.c_int64),
         _ptr(durations, ctypes.c_int64), _ptr(algos, ctypes.c_int32),
         _ptr(name_lens, ctypes.c_int32))
+
+
+def frontdoor_encode_resp(status: np.ndarray, limit: np.ndarray,
+                          remaining: np.ndarray, reset: np.ndarray,
+                          flags, n: int, out: np.ndarray) -> int:
+    """Stateless worker-side encode: decision columns (ripped straight out
+    of the completion-ring slab, core/shm_ring.py) -> serialized
+    GetRateLimitsResp bytes in `out`.  The response-direction mirror of
+    frontdoor_parse_req: the engine ships columns, the worker owns the
+    protobuf.  flags is an int32 column (0 = plain decision, 1..5 = shed
+    reason code per shm_ring.SHED_REASON_CODES) or None.  Returns the byte
+    length, or -1 (out too small) / -2 (unknown shed code) — callers fall
+    back to the Python pb encoder.  Check available() first."""
+    lib = _load()
+    if lib is None:
+        return -1
+    fl = _ptr(flags, ctypes.c_int32) if flags is not None else None
+    return lib.frontdoor_encode_resp(
+        _ptr(status, ctypes.c_int64), _ptr(limit, ctypes.c_int64),
+        _ptr(remaining, ctypes.c_int64), _ptr(reset, ctypes.c_int64),
+        fl, n, _ptr(out, ctypes.c_uint8), out.nbytes)
 
 
 class NativeRouter:

@@ -1291,6 +1291,91 @@ int64_t frontdoor_parse_req(const uint8_t* buf, int64_t len,
   return n;
 }
 
+// Response-direction mirror of frontdoor_parse_req (core/shm_ring.py):
+// encode DECISION COLUMNS (status, limit, remaining, reset_time, shed
+// flag) into a serialized GetRateLimitsResp, in the worker's process —
+// the engine's completion path ships columns over the completion-ring
+// slab and never serializes protobuf for columnar records.  Stateless
+// like the parse lane: no Router*, byte-compatible with the engine's
+// fastpath_encode_w emit loop (proto3 zero-field omission) plus the
+// metadata map entries of qos/admission.py's shed_response for flagged
+// items.  flags[i] == 0 is a plain decision; 1..5 index SHED_REASONS
+// (the code table mirrored in shm_ring.py SHED_REASON_CODES).
+// Returns the byte length, or -1 if out_cap is too small, or -2 for an
+// unknown shed code (caller falls back to the Python encoder).
+static const char* SHED_REASONS[] = {
+    "", "queue_full", "deadline", "breaker_open", "draining", "ring_full"};
+constexpr int64_t N_SHED_REASONS = 6;
+
+int64_t frontdoor_encode_resp(const int64_t* status, const int64_t* limit,
+                              const int64_t* remaining, const int64_t* reset,
+                              const int32_t* flags, int64_t n,
+                              uint8_t* out, int64_t out_cap) {
+  uint8_t* w = out;
+  uint8_t* wend = out + out_cap;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t st = status[i], li = limit[i], re = remaining[i], rs = reset[i];
+    int32_t fl = flags ? flags[i] : 0;
+    if (fl < 0 || fl >= N_SHED_REASONS) return -2;
+    // RateLimitResp: status=1, limit=2, remaining=3, reset_time=4,
+    // metadata=6 map<string,string> (proto3: zero-valued fields omitted)
+    int body = 0;
+    if (st) body += 1 + varint_size((uint64_t)st);
+    if (li) body += 1 + varint_size((uint64_t)li);
+    if (re) body += 1 + varint_size((uint64_t)re);
+    if (rs) body += 1 + varint_size((uint64_t)rs);
+    int64_t rl = 0;
+    if (fl) {
+      rl = (int64_t)strlen(SHED_REASONS[fl]);
+      // entry "shed" -> "true": 0x32 len {0x0a 4 shed 0x12 4 true}
+      // entry "shed_reason" -> reason: 0x32 len {0x0a 11 ... 0x12 rl ...}
+      body += 14 + 1 + (int)varint_size((uint64_t)(15 + rl)) + 15 + (int)rl;
+    }
+    if (w + 1 + varint_size((uint64_t)body) + body > wend) return -1;
+    *w++ = (1u << 3) | 2;  // GetRateLimitsResp.responses
+    w = write_varint(w, (uint64_t)body);
+    if (st) {
+      *w++ = (1u << 3) | 0;
+      w = write_varint(w, (uint64_t)st);
+    }
+    if (li) {
+      *w++ = (2u << 3) | 0;
+      w = write_varint(w, (uint64_t)li);
+    }
+    if (re) {
+      *w++ = (3u << 3) | 0;
+      w = write_varint(w, (uint64_t)re);
+    }
+    if (rs) {
+      *w++ = (4u << 3) | 0;
+      w = write_varint(w, (uint64_t)rs);
+    }
+    if (fl) {
+      *w++ = (6u << 3) | 2;  // metadata["shed"] = "true"
+      *w++ = 12;
+      *w++ = (1u << 3) | 2;
+      *w++ = 4;
+      memcpy(w, "shed", 4);
+      w += 4;
+      *w++ = (2u << 3) | 2;
+      *w++ = 4;
+      memcpy(w, "true", 4);
+      w += 4;
+      *w++ = (6u << 3) | 2;  // metadata["shed_reason"] = reason
+      w = write_varint(w, (uint64_t)(15 + rl));
+      *w++ = (1u << 3) | 2;
+      *w++ = 11;
+      memcpy(w, "shed_reason", 11);
+      w += 11;
+      *w++ = (2u << 3) | 2;
+      *w++ = (uint8_t)rl;
+      memcpy(w, SHED_REASONS[fl], (size_t)rl);
+      w += rl;
+    }
+  }
+  return w - out;
+}
+
 // Columnar-input sibling of fastpath_parse_stack for already-parsed request
 // lists (the batcher's Python-side jobs).  Same drain protocol, same
 // monotonic spill, same no-side-effects-on-fallback guarantee.

@@ -456,6 +456,48 @@ class Metrics:
             ["worker"],
             registry=self.registry,
         )
+        # worker-side response encoding (frontdoor.py): path=worker means
+        # the worker built protobuf bytes from decision columns the engine
+        # left in the completion-ring slab; path=engine means the slab
+        # carried pre-serialized bytes (encode_mode=engine, or a response
+        # shape columns cannot express, e.g. errors / owner metadata)
+        self.frontdoor_encode = Counter(
+            "guber_tpu_frontdoor_encode_total",
+            "GetRateLimits responses delivered per worker, by encode "
+            "path (worker = encoded from completion-ring decision "
+            "columns; engine = pre-serialized on the engine).",
+            ["worker", "path"],
+            registry=self.registry,
+        )
+        self.frontdoor_batched_rpcs = Counter(
+            "guber_tpu_frontdoor_batched_rpcs_total",
+            "RPCs coalesced into multi-RPC columnar slab records by "
+            "batched wire reads, per worker.",
+            ["worker"],
+            registry=self.registry,
+        )
+        self.frontdoor_batch_flushes = Counter(
+            "guber_tpu_frontdoor_batch_flushes_total",
+            "Multi-RPC batch records published to the shm ring "
+            "(KIND_BATCH_COLS), per worker.",
+            ["worker"],
+            registry=self.registry,
+        )
+        # cluster scale-out surface (core/service.py): ring membership and
+        # the cross-node forwarding tax the load harness
+        # (scripts/load_cluster.py) reads to report peer overhead
+        self.cluster_peers = Gauge(
+            "guber_tpu_cluster_peers",
+            "Peers in the installed consistent-hash ring, self included "
+            "(0 until the first membership update).",
+            registry=self.registry,
+        )
+        self.cluster_forwarded = Counter(
+            "guber_tpu_cluster_forwarded_total",
+            "Rate-limit items forwarded to their owning peer (both the "
+            "per-item path and the native lane's spliced batches).",
+            registry=self.registry,
+        )
         self._stage_rings: Dict[str, _StageRing] = {}
         self._stage_rings_lock = threading.Lock()
         self._slo_sink = None
@@ -566,11 +608,11 @@ class Metrics:
         from gubernator_tpu.core import shm_ring as _sr
         last: Dict[tuple, int] = {}
 
-        def _delta(w: str, field: int, counter) -> None:
+        def _delta(w: str, field: int, counter, **lbls) -> None:
             cur = hub.status.get_w(int(w), field)
             prev = last.get((w, field), 0)
             if cur > prev:
-                counter.labels(worker=w).inc(cur - prev)
+                counter.labels(worker=w, **lbls).inc(cur - prev)
                 last[(w, field)] = cur
 
         def refresh():
@@ -582,6 +624,12 @@ class Metrics:
                 _delta(w, _sr.W_RPCS, self.frontdoor_rpcs)
                 _delta(w, _sr.W_SHEDS, self.frontdoor_sheds)
                 _delta(w, _sr.W_STALLS, self.shm_ring_stalls)
+                _delta(w, _sr.W_ENCODES, self.frontdoor_encode,
+                       path="worker")
+                _delta(w, _sr.W_ENC_FALLBACK, self.frontdoor_encode,
+                       path="engine")
+                _delta(w, _sr.W_BATCH_RPCS, self.frontdoor_batched_rpcs)
+                _delta(w, _sr.W_BATCH_FLUSHES, self.frontdoor_batch_flushes)
                 if hub.chans:
                     self.shm_ring_depth.labels(worker=w).set(
                         hub.chans[i].sub_depth())

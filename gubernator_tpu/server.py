@@ -49,6 +49,21 @@ async def serve_get_rate_limits(inst: Instance, data: bytes,
     """V1.GetRateLimits engine-side body: bytes in, response bytes out.
     `context` only needs time_remaining() and abort() (which must raise) —
     satisfied by both grpc.aio contexts and the frontdoor shim."""
+    kind, val = await serve_get_rate_limits_inner(inst, data, context)
+    if kind == "bytes":
+        return val
+    return pb.GetRateLimitsResp(
+        responses=[pb.resp_to_pb(r) for r in val]).SerializeToString()
+
+
+async def serve_get_rate_limits_inner(inst: Instance, data: bytes, context):
+    """GetRateLimits body WITHOUT the final serialization: returns
+    ("bytes", out) when the native RPC lane already encoded, or
+    ("resps", [RateLimitResp]) from the Python path.  The frontdoor hub
+    uses this directly so the response direction has ONE code path — it
+    ships decision columns to the worker (which encodes in its own
+    process) instead of serializing on the engine loop; the in-process
+    server wraps it with the classic engine-side serialize above."""
     m = inst.metrics
     start = time.monotonic()
     # QoS: propagate the client's gRPC deadline into admission control,
@@ -70,7 +85,7 @@ async def serve_get_rate_limits(inst: Instance, data: bytes,
         if out is not None:
             m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start,
                           ok=True)
-            return out
+            return "bytes", out
     try:
         request = pb.GetRateLimitsReq.FromString(data)
     except Exception:
@@ -92,8 +107,7 @@ async def serve_get_rate_limits(inst: Instance, data: bytes,
         m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=False)
         await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
     m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=True)
-    return pb.GetRateLimitsResp(
-        responses=[pb.resp_to_pb(r) for r in resps]).SerializeToString()
+    return "resps", resps
 
 
 async def serve_peer_rate_limits(inst: Instance, data: bytes,

@@ -100,10 +100,12 @@ def fd(loop):
 @pytest.fixture(scope="module")
 def solo_hub(loop, oracle):
     """A one-worker, two-slot hub bolted onto the oracle's instance: small
-    enough to exhaust the ring on demand, isolated enough to crash."""
+    enough to exhaust the ring on demand, isolated enough to crash.
+    batch_reads=1 disables wire-read coalescing so every RPC occupies its
+    own slot — the overflow/crash tests count slots deterministically."""
     hub = FrontdoorHub(oracle.instance, workers=1, ring_slots=2,
                        slab_bytes=DaemonConfig.shm_slab_bytes,
-                       listen_address="127.0.0.1:0")
+                       listen_address="127.0.0.1:0", batch_reads=1)
     run(loop, hub.start())
     yield hub
     run(loop, hub.stop())
@@ -427,9 +429,169 @@ def test_frontdoor_observability_surface(loop, fd):
     assert len(snap["per_worker"]) == 2
     assert all(r["pid"] > 0 for r in snap["per_worker"])
     assert snap["port_mode"] in ("reuseport", "per-worker-ports")
+    assert snap["encode_mode"] == "worker"
     text = fd.instance.metrics.expose().decode()
     for fam in ("guber_tpu_frontdoor_workers",
                 "guber_tpu_frontdoor_rpcs_total",
                 "guber_tpu_frontdoor_restarts_total",
+                "guber_tpu_frontdoor_encode_total",
                 "guber_tpu_shm_ring_depth"):
         assert fam in text, fam
+
+
+def test_native_response_encoder_parity():
+    """frontdoor_encode_resp (the worker's native response encoder) vs
+    the protobuf library over random decision columns.  Plain rows must
+    be BYTE-identical; shed rows carry a 2-entry metadata map whose
+    serialization order the protobuf runtime does not define, so they
+    are compared parse-exactly instead."""
+    import numpy as np
+
+    from gubernator_tpu import native
+    from gubernator_tpu.api import types
+    from gubernator_tpu.core.shm_ring import SHED_CODE_REASONS
+
+    rng = np.random.default_rng(7)
+    n = 64
+    st = rng.integers(0, 2, n).astype(np.int64)
+    li = rng.integers(0, 2**40, n).astype(np.int64)
+    re_ = rng.integers(0, 2**40, n).astype(np.int64)
+    rs = rng.integers(0, 2**52, n).astype(np.int64)
+    fl = np.zeros(n, dtype=np.int32)
+    shed_rows = np.arange(0, n, 7)
+    fl[shed_rows] = rng.integers(1, 6, len(shed_rows)).astype(np.int32)
+    out = np.empty(n * 96 + 64, dtype=np.uint8)
+    ln = native.frontdoor_encode_resp(st, li, re_, rs, fl, n, out)
+    if ln < 0:
+        pytest.skip("native library unavailable")
+
+    def model(j, flags):
+        md = {}
+        if flags[j]:
+            md = {"shed": "true",
+                  "shed_reason": SHED_CODE_REASONS[int(flags[j])]}
+        return types.RateLimitResp(
+            status=int(st[j]), limit=int(li[j]), remaining=int(re_[j]),
+            reset_time=int(rs[j]), metadata=md)
+
+    got = pb.GetRateLimitsResp.FromString(bytes(out[:ln]))
+    want = pb.GetRateLimitsResp(
+        responses=[pb.resp_to_pb(model(j, fl)) for j in range(n)])
+    assert len(got.responses) == n
+    for j, (g, w) in enumerate(zip(got.responses, want.responses)):
+        assert g.status == w.status, j
+        assert g.limit == w.limit, j
+        assert g.remaining == w.remaining, j
+        assert g.reset_time == w.reset_time, j
+        assert dict(g.metadata) == dict(w.metadata), j
+
+    # with no shed rows the whole stream is byte-identical
+    fl0 = np.zeros(n, dtype=np.int32)
+    ln0 = native.frontdoor_encode_resp(st, li, re_, rs, fl0, n, out)
+    plain = pb.GetRateLimitsResp(
+        responses=[pb.resp_to_pb(model(j, fl0))
+                   for j in range(n)]).SerializeToString()
+    assert bytes(out[:ln0]) == plain
+
+
+def test_differential_batched_wire_reads(loop, oracle, fd):
+    """Concurrent small RPCs on one connection coalesce into multi-RPC
+    slab records (KIND_BATCH_COLS: one slab write, one publish, one
+    columnar completion split back per RPC).  Decisions must match the
+    oracle item-for-item, and the batch/encode counters must show the
+    coalesced path actually ran."""
+
+    async def body():
+        ocl = AsyncClient(oracle.grpc.address)
+        fcl = AsyncClient(fd.frontdoor.address)
+        st0 = fd.frontdoor.stats()
+        try:
+            for rnd in range(20):
+                singles = [[req("fd_batchr", f"b:{rnd}:{i}", limit=9)]
+                           for i in range(32)]
+                want = await asyncio.gather(
+                    *[ocl.get_rate_limits(b, timeout=60) for b in singles])
+                got = await asyncio.gather(
+                    *[fcl.get_rate_limits(b, timeout=60) for b in singles])
+                for i, (g, w) in enumerate(zip(got, want)):
+                    _assert_same(g, w, f"batched {rnd}:{i}")
+                st = fd.frontdoor.stats()
+                if st["batch_flushes"] > st0["batch_flushes"]:
+                    break
+        finally:
+            await ocl.close()
+            await fcl.close()
+        st = fd.frontdoor.stats()
+        # coalescing happened: at least one multi-RPC record, covering at
+        # least two RPCs, and the responses were worker-encoded
+        assert st["batch_flushes"] > st0["batch_flushes"]
+        assert (st["batch_rpcs"] - st0["batch_rpcs"]
+                >= 2 * (st["batch_flushes"] - st0["batch_flushes"]))
+        assert st["encodes"] > st0["encodes"]
+
+    run(loop, body(), timeout=300)
+
+
+def test_stale_epoch_completion_not_encoded(loop, oracle, solo_hub):
+    """Response-direction crash safety: a record the engine popped BEFORE
+    a worker crash must not be completed into the respawned worker's
+    recycled slab — the hub's epoch guard drops the stale columnar
+    completion, so the new worker never encodes a dead epoch's decision
+    columns."""
+    hub = solo_hub
+
+    async def body():
+        cl = AsyncClient(hub.address)
+        pid0 = hub.status.get_w(0, shm_ring.W_PID)
+        restarts0 = hub.restarts
+        _pause_consumer(hub)
+        doomed = asyncio.ensure_future(cl.get_rate_limits(
+            [req("fd_stale", "victim", limit=10)], timeout=60))
+        deadline = time.monotonic() + 20
+        while hub.chans[0].sub_depth() < 1:
+            assert time.monotonic() < deadline, "record never submitted"
+            await asyncio.sleep(0.01)
+        # pop the record exactly like the consumer thread would, capturing
+        # the pre-crash epoch alongside it
+        with hub._locks[0]:
+            recs = hub.chans[0].pop()
+            epoch0 = hub.epochs[0]
+        assert recs
+        os.kill(pid0, signal.SIGKILL)
+        with pytest.raises(Exception):
+            await doomed
+        await cl.close()
+        deadline = time.monotonic() + 60
+        while hub.restarts == restarts0:
+            assert time.monotonic() < deadline, "worker never restarted"
+            await asyncio.sleep(0.1)
+        # monitor reset the ring for the respawned epoch
+        comp0 = int(hub.chans[0]._hdr[shm_ring._COMP_TAIL])
+        served0 = hub.records_served
+        # the engine only now finishes serving the stale record...
+        for rec in recs:
+            await hub._serve(0, epoch0, rec)
+        assert hub.records_served == served0 + len(recs)
+        # ...and the epoch guard swallowed the completion: no entry was
+        # published into the new worker's completion ring, no response
+        # columns were written into its recycled slab
+        assert int(hub.chans[0]._hdr[shm_ring._COMP_TAIL]) == comp0
+        assert hub.chans[0].inflight() == 0
+        _resume_consumer(hub)
+
+        # the respawned worker serves normally afterwards
+        cl2 = AsyncClient(hub.address)
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                rs = await cl2.get_rate_limits(
+                    [req("fd_stale", "fresh", limit=10)], timeout=5)
+                break
+            except Exception:
+                assert time.monotonic() < deadline, "respawn never came up"
+                await asyncio.sleep(0.25)
+        assert rs[0].status == Status.UNDER_LIMIT
+        assert rs[0].remaining == 9
+        await cl2.close()
+
+    run(loop, body(), timeout=300)

@@ -52,6 +52,13 @@ NATIVE_NAMES = (
     "guber_tpu_frontdoor_restarts",
     "guber_tpu_shm_ring_depth",
     "guber_tpu_shm_ring_stalls",
+    # worker-side response encoding + batched wire reads (frontdoor.py)
+    "guber_tpu_frontdoor_encode",
+    "guber_tpu_frontdoor_batched_rpcs",
+    "guber_tpu_frontdoor_batch_flushes",
+    # multi-node scale-out surface (core/service.py, scripts/load_cluster.py)
+    "guber_tpu_cluster_peers",
+    "guber_tpu_cluster_forwarded",
     # tiered key state (state/tiers.py)
     "guber_tpu_tier_events_total",
     "guber_tpu_tier_warm_rows",
