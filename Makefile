@@ -102,6 +102,7 @@ bench:
 # window p99, tiers-on vs tiers-off).
 bench-smoke:
 	python scripts/bench_compare.py
+	GUBER_PROBE_PLATFORM=cpu python scripts/probe_census.py
 	GUBER_PROBE_PLATFORM=cpu python scripts/probe_overlap.py
 	GUBER_PROBE_PLATFORM=cpu GUBER_PROBE_FD_WORKERS=0,2 GUBER_PROBE_SECONDS=2 python scripts/probe_frontdoor.py
 	GUBER_PROBE_PLATFORM=cpu GUBER_PROBE_B=1024 GUBER_PROBE_C=4096 GUBER_PROBE_SECONDS=1 python scripts/probe_chain.py

@@ -870,6 +870,49 @@ def bench_pallas_probe(on_cpu):
                 "pallas_error": f"{type(e).__name__}: {str(e)[:200]}"}
 
 
+def bench_census(result):
+    """Record the per-arm executed-kernel census in the BENCH json.  The
+    census is a property of the traced program — box-independent — so it
+    lives at the TOP level (never under cpu_smoke) and bench_compare.py
+    gates it without a host fingerprint.  Runs scripts/probe_census.py in
+    a CPU subprocess: the trace must never claim the chip (TPU runtimes
+    are single-process-exclusive) and the numbers come out identical
+    either way.  The composed serving arm's kernels_per_window and the
+    cost-model projection are lifted to top-level keys."""
+    probe = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "scripts", "probe_census.py")
+    out = os.environ[OUT_ENV] + ".census.json"
+    try:
+        env = dict(os.environ, GUBER_PROBE_PLATFORM="cpu",
+                   GUBER_PROBE_JSON=out)
+        proc = subprocess.run([sys.executable, probe], timeout=240,
+                              capture_output=True, env=env)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                (proc.stderr or b"").decode(errors="replace")[-200:])
+        with open(out) as f:
+            data = json.loads(f.read())
+        arms = {a["arm"]: a for a in data.get("arms", [])}
+        result["census_kernels_per_window"] = {
+            k: a["kernels_per_window"] for k, a in arms.items()}
+        head = arms.get("composed_analytics") or arms.get("composed_drain")
+        if head:
+            result["kernels_per_window"] = head["kernels_per_window"]
+            result["projected_chip_decisions_per_sec"] = \
+                head["projected_chip_decisions_per_sec"]
+        log(f"# census: {result.get('census_kernels_per_window')} "
+            f"kernels/window; projected "
+            f"{result.get('projected_chip_decisions_per_sec', 0):,} "
+            f"decisions/s on-chip")
+    except Exception as e:  # noqa: BLE001 — telemetry, not a tier
+        log(f"# census probe skipped: {type(e).__name__}: {str(e)[:200]}")
+    finally:
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+
+
 def _load_tpu_checkpoint():
     try:
         with open(TPU_CHECKPOINT) as f:
@@ -1069,6 +1112,10 @@ def child_main():
 
     tunnel_error = None
     try:
+        # box-independent census first: a later tunnel wedge or tier crash
+        # must not cost the gateable kernel-ladder record
+        bench_census(result)
+        checkpoint()
         try:
             if not os.environ.get("GUBER_BENCH_PLATFORM"):
                 # real-TPU path: probe-only wedge check (chip left free),

@@ -88,6 +88,14 @@ class EngineConfig:
     # mixed-config hot-key floods).  0 disables; uniform duplicates are
     # never split (the closed form is O(1) in run length).
     replay_cap: int = 128
+    # Operator promise that this deployment serves NO GLOBAL-behavior
+    # traffic (env: GUBER_SKIP_GLOBAL=1): stacked dispatches always use
+    # the GLOBAL-skipping twin executable.  Unlike the single-process
+    # inertness gate (engine.step_windows), a config-level flag is
+    # identical on every mesh process, so the skip is mesh-legal — the
+    # executable choice never depends on per-tick staging.  GLOBAL
+    # requests submitted anyway are rejected loudly.
+    skip_global: bool = False
 
 
 @dataclass
@@ -613,6 +621,8 @@ def config_from_env(env_file: Optional[str] = None) -> DaemonConfig:
         e.exact_keys = _env("GUBER_EXACT_KEYS") == "1"
     if _env("GUBER_REPLAY_CAP"):
         e.replay_cap = int(_env("GUBER_REPLAY_CAP"))
+    if _env("GUBER_SKIP_GLOBAL"):
+        e.skip_global = _env("GUBER_SKIP_GLOBAL") == "1"
 
     # QoS / overload control (gubernator_tpu/qos/; full list example.conf)
     q = c.qos

@@ -160,6 +160,7 @@ class RateLimitEngine:
         use_native: str = "auto",
         exact_keys: bool = False,
         replay_cap: "Optional[int]" = None,
+        skip_global: bool = False,
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.num_shards = int(np.prod(list(self.mesh.shape.values())))
@@ -168,6 +169,13 @@ class RateLimitEngine:
         self.global_capacity = global_capacity
         self.global_batch_per_shard = global_batch_per_shard
         self.max_global_updates = max_global_updates
+        # Config-level promise of zero GLOBAL traffic (EngineConfig
+        # .skip_global / GUBER_SKIP_GLOBAL): stacked dispatches always
+        # lower to the GLOBAL-skipping twin.  Being config-driven it is
+        # identical on every mesh process, which is what makes the skip
+        # legal under the mesh collective contract — unlike the
+        # single-process per-stack inertness gate in step_windows.
+        self._skip_global = bool(skip_global)
 
         # Mesh mode (parallel/distributed.py): the mesh spans processes;
         # this host stages lanes only for its contiguous run of shards and
@@ -992,18 +1000,30 @@ class RateLimitEngine:
         # Empty-GLOBAL skip: when this stack carries no GLOBAL lanes and
         # the control plane is inert (every slot points one past the
         # arena), dispatch the GLOBAL-skipping twin — same output shape,
-        # minus the per-window GLOBAL gathers/scatters/psum.  Host-staged
-        # numpy only (resident stacks are unscannable) and single-process
-        # only: in mesh mode the executable choice is part of the
-        # collective contract and must not depend on per-process staging.
+        # minus the per-window GLOBAL gathers/scatters/psum.  Two gates:
+        #
+        #   * static (mesh-legal): the engine was configured skip_global —
+        #     a config-level promise of zero GLOBAL traffic, identical on
+        #     every process, so the twin IS the collective sequence.
+        #     Active GLOBAL lanes under the promise are a caller bug and
+        #     raise (host-staged stacks only; resident are unscannable).
+        #   * dynamic (single-process only): host-staged inertness picks
+        #     the twin per stack.  In mesh mode this choice would depend
+        #     on per-process staging and break the collective contract.
         fn = self._multi_fn
         G = self.global_capacity
-        if (not self.multiprocess
-                and isinstance(gbatches.slot, np.ndarray)
-                and not (gbatches.slot >= 0).any()
-                and (np.asarray(upd[0]) >= G).all()
-                and (np.asarray(upd[4]) >= G).all()
-                and (np.asarray(ups[0]) >= G).all()):
+        inert = (isinstance(gbatches.slot, np.ndarray)
+                 and not (gbatches.slot >= 0).any()
+                 and (np.asarray(upd[0]) >= G).all()
+                 and (np.asarray(upd[4]) >= G).all()
+                 and (np.asarray(ups[0]) >= G).all())
+        if self._skip_global:
+            if isinstance(gbatches.slot, np.ndarray) and not inert:
+                raise ValueError(
+                    "engine configured skip_global=True received GLOBAL "
+                    "lanes or control-plane writes")
+            fn = _compiled_multi_step(self.mesh, with_global=False)
+        elif not self.multiprocess and inert:
             fn = _compiled_multi_step(self.mesh, with_global=False)
         if self.multiprocess:
             batches = WindowBatch(*[self._sharded_in_stacked(np.asarray(a))
@@ -1159,7 +1179,9 @@ class RateLimitEngine:
         now = self._resolve_now(now)
         if k_stack is not None and k_stack > 1:
             self.step_stacked([[]], now, k_stack=k_stack)
-            if not self.multiprocess:
+            # skip_global engines never dispatch the GLOBAL-carrying
+            # variant, so there is nothing extra to warm
+            if not self.multiprocess and not self._skip_global:
                 # the empty warm stack above lowers to the GLOBAL-skipping
                 # twin (step_windows inertness gate); execute the
                 # GLOBAL-carrying variant on the same inert stack too —
@@ -1204,29 +1226,43 @@ class RateLimitEngine:
             if k_stack is not None:
                 # lockstep serving (single-process mesh behind a tick
                 # clock): the tick's drain is the GLOBAL-composed variant
-                # at the tick's fixed shape
+                # at the tick's fixed shape — the analytics-composed
+                # flavor when analytics is wired (that IS the tick
+                # executable then; the plain one would never run)
                 kb = max(k_stack, 1)
                 packed = np.zeros(
                     (kb, self.num_shards, self.batch_per_shard, 2), np.int64)
                 gbatch, gacc, upd = self.empty_drain_control()
-                _, _, _, gfused = self.pipeline_dispatch_global(
+                out = self.pipeline_dispatch_global(
                     packed, np.full(kb, now, np.int64), gbatch, gacc, upd,
-                    n_windows=0)
-                jax.device_get(gfused)
+                    n_windows=0,
+                    analytics_args=self._warm_analytics_args(kb))
+                jax.device_get(out[3])
         elif self.native is not None and self.multiprocess:
             # mesh lockstep drain: ONE fixed shape (the tick's k_stack),
             # dispatched collectively — every process warms it together.
             # The tick drain is the GLOBAL-composed variant (one psum per
-            # drain, core/pipeline.py lockstep mode).
+            # drain, core/pipeline.py lockstep mode), analytics-composed
+            # when analytics is wired.
             kb = max(k_stack or 1, 1)
             packed = np.zeros(
                 (kb, self.num_local_shards, self.batch_per_shard, 2),
                 np.int64)
             gbatch, gacc, upd = self.empty_drain_control()
-            _, _, mism, _ = self.pipeline_dispatch_global(
+            out = self.pipeline_dispatch_global(
                 packed, np.full(kb, now, np.int64), gbatch, gacc, upd,
-                n_windows=0)
-            self._fetch_local_stacked(mism)
+                n_windows=0, analytics_args=self._warm_analytics_args(kb))
+            self._fetch_local_stacked(out[2])
+
+    def _warm_analytics_args(self, kb: int):
+        """Inert analytics_args for warmup's composed-drain dispatch, or
+        None when analytics is not wired (matching the executable the
+        lockstep tick will actually use).  Zero tenants + decay=0 leave
+        the fresh sketch all-zero."""
+        if self._an_conf is None:
+            return None
+        return (np.zeros((kb, self.num_local_shards, self.batch_per_shard),
+                         np.int32), 0)
 
     def _resolve_now(self, now: Optional[int]) -> int:
         """Default `now` to wall clock — except in mesh mode, where the
@@ -1364,6 +1400,17 @@ class RateLimitEngine:
         sequence), staging its own local lanes; replicated control inputs
         (upd/ups/now) must be identical everywhere."""
         buf = self._buf
+        if self._skip_global:
+            # same config-level promise as step_stacked's static gate:
+            # zero GLOBAL traffic ever reaches a skip_global engine.
+            # (warmup dispatches inert buffers with fetch_global=True, so
+            # the check scans the staged lanes, not the fetch flag)
+            G = self.global_capacity
+            if ((buf.gslot >= 0).any() or (buf.uslot < G).any()
+                    or (buf.rslot < G).any() or (buf.pslot < G).any()):
+                raise ValueError(
+                    "engine configured skip_global=True received GLOBAL "
+                    "lanes or control-plane writes")
         compact = self._compact_eligible(buf)
         # Occupied-prefix buckets apply only to the compact path: the full
         # format is the rare fallback and warmup compiles it only at full
@@ -1473,7 +1520,8 @@ class RateLimitEngine:
         return words, limits, mism
 
     def pipeline_dispatch_global(self, packed, nows, gbatch, gacc, upd,
-                                 n_windows: Optional[int] = None):
+                                 n_windows: Optional[int] = None,
+                                 analytics_args=None):
         """The mesh serving drain: pipeline_dispatch's K-window compact
         stack PLUS one GLOBAL window (replica reads + the reconciliation
         psum + config writes), all in ONE device call with ONE collective
@@ -1492,7 +1540,15 @@ class RateLimitEngine:
 
         Mesh mode: same lockstep contract as pipeline_dispatch — every
         process dispatches this at the same sequence position with the
-        same K and identical nows/upd, every tick, staged lanes or not."""
+        same K and identical nows/upd, every tick, staged lanes or not.
+
+        `analytics_args=(tenants, decay)` composes the per-drain stats
+        reduction into THE SAME dispatch (the analytics-geometry variant
+        of the composed executable): tenants i32[K, S_local, B] host-staged
+        ids, decay the 0/1 halving flag.  Returns an extra `stats`
+        i64[S, V] (un-fetched) and updates the resident sketch in place.
+        Enablement is config-level, so every mesh process picks the same
+        variant — the executable choice never depends on per-tick data."""
         if self.multiprocess:
             packed = self._sharded_in_stacked(np.ascontiguousarray(packed))
             nows = self._repl_in(np.asarray(nows, np.int64))
@@ -1500,6 +1556,27 @@ class RateLimitEngine:
                                    for a in gbatch])
             gacc = self._sharded_in(np.asarray(gacc))
             upd = tuple(self._repl_in(a) for a in upd)
+        if analytics_args is not None:
+            conf = self._an_conf
+            tenants, decay = analytics_args
+            if self.multiprocess:
+                tenants = self._sharded_in_stacked(
+                    np.ascontiguousarray(tenants))
+                decay_in = self._repl_in(np.int64(decay))
+            else:
+                decay_in = jnp.int64(decay)
+            fn = _compiled_pipeline_step_global(
+                self.mesh, (conf.sketch_depth, conf.sketch_width,
+                            conf.tenant_slots, conf.topk, conf.over_weight))
+            with jax.profiler.StepTraceAnnotation(
+                    "guber_drain", step_num=self.windows_processed):
+                (self.state, words, limits, mism, gfused,
+                 self.gstate, self.gcfg, self._an_sketch, stats) = fn(
+                    self.state, self.gstate, self.gcfg, packed, gbatch,
+                    gacc, upd, nows, self._an_sketch, tenants, decay_in)
+            self.windows_processed += (int(packed.shape[0])
+                                       if n_windows is None else n_windows)
+            return words, limits, mism, gfused, stats
         fn = _compiled_pipeline_step_global(self.mesh)
         with jax.profiler.StepTraceAnnotation(
                 "guber_drain", step_num=self.windows_processed):
@@ -1513,15 +1590,24 @@ class RateLimitEngine:
 
     # ------------------------------------------------------ traffic analytics
     #
-    # The per-drain stats reduction (ops/analytics.py) runs as its OWN
-    # executable over the drain's inputs/outputs, so the drain builders
-    # above stay byte-identical whether analytics is on or off — the
-    # disabled serving path is provably unchanged (tests/test_analytics.py
-    # census).  The reduction is collective-free: each shard emits its own
-    # stats row and the host merges its local blocks, so it is safe to
-    # dispatch outside the lockstep collective contract (every process
-    # still issues it at the same sequence position because the enabled
-    # flag comes from config, identical everywhere).
+    # The per-drain stats reduction (ops/analytics.py) has two homes:
+    #
+    #   * the regular (non-lockstep) pipeline runs it as its OWN
+    #     executable over the drain's inputs/outputs (analytics_dispatch
+    #     below), so the drain builders stay byte-identical whether
+    #     analytics is on or off — the disabled serving path is provably
+    #     unchanged (tests/test_analytics.py census);
+    #   * the lockstep tick composes it INTO the GLOBAL-composed drain
+    #     (pipeline_dispatch_global's analytics_args): one dispatch, one
+    #     collective-sequence slot, and the reduction reads the drain's
+    #     words and post-drain expiry plane in place.  The analytics=None
+    #     builder is still byte-identical — composition is a separate
+    #     lru_cache entry keyed on the config-level geometry.
+    #
+    # The reduction is collective-free either way: each shard emits its
+    # own stats row and the host merges its local blocks, so the separate
+    # executable is safe to dispatch outside the lockstep collective
+    # contract, and the composed variant adds no collective to the drain.
 
     _an_conf = None
     _an_sketch = None
@@ -2539,9 +2625,11 @@ def _global_window(gstate: BucketState, gcfg: GlobalConfig, gb: WindowBatch,
     """One window of GLOBAL traffic: replica reads + the reconciliation psum.
 
     The whole GLOBAL dance — the reference's async hit send plus owner
-    broadcast (global.go:72-232) — is this one collective.
+    broadcast (global.go:72-232) — is this one collective.  The read and
+    apply halves share one transition ladder (kernel.global_combined):
+    reads see the pre-apply replica either way, so concatenating the lane
+    sets halves the sub-window's executed kernels without changing a bit.
     """
-    gout = kernel.global_read(gstate, gb, now)
     delta = kernel.global_accumulate(
         jnp.zeros_like(gstate.remaining), gb._replace(hits=gacc_row)
     )
@@ -2553,11 +2641,11 @@ def _global_window(gstate: BucketState, gcfg: GlobalConfig, gb: WindowBatch,
     # so a rebased-i32 form would not be exact — XLA serves the TPU path.
     if pallas and _mesh_on_cpu(mesh):
         from gubernator_tpu.ops.pallas_kernel import global_apply_pallas
+        gout = kernel.global_read(gstate, gb, now)
         new_g = global_apply_pallas(
             gstate, gcfg, summed, now, interpret=True)
-    else:
-        new_g = kernel.global_apply(gstate, gcfg, summed, now)
-    return new_g, gout
+        return new_g, gout
+    return kernel.global_combined(gstate, gcfg, gb, summed, now)
 
 
 def _compiled_step(mesh: Mesh):
@@ -2870,15 +2958,17 @@ def _compiled_analytics_reduce(mesh: Mesh, depth: int, width: int,
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def _compiled_pipeline_step_global(mesh: Mesh):
+def _compiled_pipeline_step_global(mesh: Mesh, analytics=None):
     return _compiled_pipeline_step_global_impl(mesh, _use_pallas(),
                                                _use_compact32_xla(),
-                                               _use_pallas_fused())
+                                               _use_pallas_fused(),
+                                               analytics)
 
 
 @lru_cache(maxsize=None)
 def _compiled_pipeline_step_global_impl(mesh: Mesh, pallas: bool,
-                                        c32xla: bool, fused: bool = False):
+                                        c32xla: bool, fused: bool = False,
+                                        analytics=None):
     """The mesh serving drain: _compiled_pipeline_step's K-scan PLUS one
     GLOBAL reconciliation window composed around it — the lockstep tick's
     single executable.
@@ -2898,11 +2988,23 @@ def _compiled_pipeline_step_global_impl(mesh: Mesh, pallas: bool,
     the upd 5-tuple only (config refresh + reallocation resets): drains
     never carry upserts.  Donation covers the sharded arena and the
     replicated GLOBAL arena/config, so planes are carried, not copied,
-    across ticks."""
-    def shard_fn(state, gstate, gcfg, packed, gbatch, gacc, upd, nows):
+    across ticks.
+
+    `analytics` (None or the geometry 5-tuple (sketch_depth, sketch_width,
+    tenant_slots, topk, over_weight)) composes the per-drain stats
+    reduction (ops/analytics.py shard_stats) INTO this executable: the
+    reduction reads the drain's own packed stack, its response words and
+    the post-drain expiry plane IN PLACE — no second dispatch, no second
+    executable in the tick's collective sequence.  With analytics=None the
+    traced body is byte-identical to the pre-analytics builder (the
+    analytics-off serving path is provably unchanged); the geometry is
+    config-level and identical on every process, so the executable choice
+    is mesh-legal."""
+    def shard_fn(state, gstate, gcfg, packed, gbatch, gacc, upd, nows, *an):
         # Block shapes: state [1, C]; packed [K, 1, B, 2]; gbatch/gacc
         # [1, Bg]; gstate/gcfg [G] (replicated); upd [Kg] (replicated);
-        # nows [K].
+        # nows [K]; analytics extras: sketch [1, D, W]; tenants [K, 1, B];
+        # decay [].
         st = BucketState(*jax.tree.map(lambda a: a[0], state))
         st, words, limits, mism = _drain_scan(mesh, pallas, c32xla, fused,
                                               st, packed, nows)
@@ -2916,7 +3018,7 @@ def _compiled_pipeline_step_global_impl(mesh: Mesh, pallas: bool,
              gout.reset_time], axis=-1)
 
         expand = lambda a: a[None]
-        return (
+        outs = (
             BucketState(*jax.tree.map(expand, st)),
             words[:, None],
             limits[:, None],
@@ -2925,10 +3027,44 @@ def _compiled_pipeline_step_global_impl(mesh: Mesh, pallas: bool,
             new_g,
             gcfg,
         )
+        if analytics is not None:
+            from gubernator_tpu.ops import analytics as ops_analytics
+            _, _, tenant_slots, topk, over_weight = analytics
+            sketch, tenants, decay = an
+            sk, stats = ops_analytics.shard_stats(
+                sketch[0], packed[:, 0], words, tenants[:, 0], st.expire,
+                nows[0], decay, tenant_slots=tenant_slots, topk=topk,
+                over_weight=over_weight)
+            outs = outs + (sk[None], stats[None])
+        return outs
 
     state_sharded = BucketState(*[P(SHARD_AXIS)] * 6)
     state_repl = BucketState(*[P()] * 6)
     stackedP = stacked_spec()
+    in_specs = (
+        state_sharded,
+        state_repl,
+        GlobalConfig(*[P()] * 3),
+        stackedP,
+        WindowBatch(*[shard_spec()] * 6),
+        shard_spec(),
+        (P(), P(), P(), P(), P()),
+        P(),
+    )
+    out_specs = (
+        state_sharded,
+        stackedP,
+        stackedP,
+        stackedP,
+        shard_spec(),
+        state_repl,
+        GlobalConfig(*[P()] * 3),
+    )
+    donate = (0, 1, 2)
+    if analytics is not None:
+        in_specs = in_specs + (P(SHARD_AXIS), stackedP, P())
+        out_specs = out_specs + (P(SHARD_AXIS), P(SHARD_AXIS))
+        donate = donate + (8,)  # the resident sketch is a carried plane
     sharded = _compat_shard_map(
         shard_fn,
         mesh=mesh,
@@ -2936,27 +3072,10 @@ def _compiled_pipeline_step_global_impl(mesh: Mesh, pallas: bool,
         # interpret-mode while_loop (jnp.take drops them); vma checking is
         # an XLA-path-only invariant here
         check_vma=not (pallas or fused),
-        in_specs=(
-            state_sharded,
-            state_repl,
-            GlobalConfig(*[P()] * 3),
-            stackedP,
-            WindowBatch(*[shard_spec()] * 6),
-            shard_spec(),
-            (P(), P(), P(), P(), P()),
-            P(),
-        ),
-        out_specs=(
-            state_sharded,
-            stackedP,
-            stackedP,
-            stackedP,
-            shard_spec(),
-            state_repl,
-            GlobalConfig(*[P()] * 3),
-        ),
+        in_specs=in_specs,
+        out_specs=out_specs,
     )
-    fn = jax.jit(sharded, donate_argnums=(0, 1, 2))
+    fn = jax.jit(sharded, donate_argnums=donate)
     return _recursion_guarded(fn) if (pallas or fused) else fn
 
 

@@ -1706,13 +1706,21 @@ class DispatchPipeline:
                     gjob = None
                     gbatch, gacc, upd = eng.empty_drain_control()
             # the tick's drain dispatch is unconditional and fixed-shape:
-            # every process issues it at the same sequence position
+            # every process issues it at the same sequence position.
+            # Analytics (when wired) is COMPOSED into this same dispatch —
+            # tenants are staged up front so the reduction rides the drain
+            # executable instead of occupying a second collective-sequence
+            # slot; staging failures degrade to inert zero tenants, never
+            # to a differently-shaped dispatch.
+            an_args = (self._analytics_stage(res, packed, K, now)
+                       if self.analytics is not None else None)
             before = eng.windows_processed
             dispatched = False
             try:
-                words, limits, mism, gfused = eng.pipeline_dispatch_global(
+                out = eng.pipeline_dispatch_global(
                     packed[:K], np.full(K, now, np.int64), gbatch, gacc,
-                    upd, n_windows=k_used)
+                    upd, n_windows=k_used, analytics_args=an_args)
+                words, limits, mism, gfused = out[:4]
                 dispatched = True  # sentinel: windows_processed advances
                 # by k_used, which is 0 on an idle tick — the counter
                 # alone cannot distinguish 'dispatched 0 windows' from
@@ -1734,9 +1742,13 @@ class DispatchPipeline:
                     zb, za, zu = eng.empty_drain_control()
                     for attempt in range(3):
                         try:
+                            # same executable as the failed call (the
+                            # analytics-composed variant when wired): the
+                            # collective sequence is per-EXECUTABLE
                             eng.pipeline_dispatch_global(
                                 zeros, np.full(K, now, np.int64),
-                                zb, za, zu, n_windows=0)
+                                zb, za, zu, n_windows=0,
+                                analytics_args=an_args)
                             break
                         except Exception:
                             if attempt == 2:
@@ -1754,13 +1766,17 @@ class DispatchPipeline:
                 res.words, res.limits, res.mism = words, limits, mism
                 if gjob is not None:
                     res.gfused = gfused
-            # UNCONDITIONAL in lockstep (not gated on local staging): the
-            # analytics executable is collective-free but still a global
-            # computation — every process must issue it at the same
-            # sequence position.  The decay flag derives from the tick's
-            # cluster-agreed `now`, so it too is identical everywhere.
-            if self.analytics is not None:
-                self._analytics_dispatch(res, packed, words, now)
+            if an_args is not None:
+                # composed analytics: the stats row came out of the drain
+                # dispatch itself — just start its async copy alongside
+                # the drain's own fetches
+                stats = out[4]
+                try:
+                    stats.copy_to_host_async()
+                except Exception:
+                    pass  # fetch path will block instead
+                res.stats = stats
+                res.an_decay = an_args[1]
         elif k_used:  # an all-forwarded drain has nothing to dispatch
             kb = next(b for b in self._k_buckets if b >= k_used)
             try:
@@ -1827,6 +1843,48 @@ class DispatchPipeline:
                                   for j in res.staged):
             res.cfut = self._fetch_executor.submit(self._complete_sync, res)
         return res
+
+    def _analytics_stage(self, res: _DrainResult, packed, kd: int,
+                         now: int):
+        """Host-side staging for the COMPOSED analytics reduction: build
+        the tenant lanes + slot labels BEFORE the drain dispatch so the
+        stats reduction can ride the drain executable itself (lockstep
+        mode; engine.pipeline_dispatch_global analytics_args).
+
+        Same tenant/label semantics as _analytics_dispatch below.  Any
+        failure degrades to inert zero tenants and decay=0 — analytics
+        must never fail a drain, and the lockstep dispatch must keep its
+        shape either way (only the VALUES degrade; the executable is
+        picked by config-level geometry)."""
+        from gubernator_tpu.ops.analytics import _SLOT_MASK
+        eng = self.engine
+        S = eng.num_local_shards
+        tenants = np.zeros((kd, S, eng.batch_per_shard), np.int32)
+        decay = 0
+        try:
+            an = self.analytics
+            for job in res.staged:
+                reqs = getattr(job, "reqs", None)
+                rows = getattr(job, "row", None)
+                if reqs is None or rows is None:
+                    continue
+                for i in range(job.n):
+                    row = int(rows[i])
+                    if row < 0:
+                        continue
+                    k, s = divmod(row, S)
+                    if k >= kd:
+                        continue
+                    lane = int(job.lane[i])
+                    r = reqs[i]
+                    tenants[k, s, lane] = an.tenant_id(tenant_of(r))
+                    slot = int(packed[k, s, lane, 0] & _SLOT_MASK) - 1
+                    if slot >= 0:
+                        an.label_slot(s, slot, r.hash_key())
+            decay = an.decay_flag(now)
+        except Exception:
+            log.exception("analytics staging failed (drain unaffected)")
+        return tenants, decay
 
     def _analytics_dispatch(self, res: _DrainResult, packed, words,
                             now: int) -> None:

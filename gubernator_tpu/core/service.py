@@ -83,6 +83,7 @@ class Instance:
             use_native=e.use_native,
             exact_keys=e.exact_keys,
             replay_cap=e.replay_cap,
+            skip_global=e.skip_global,
         )
         self.metrics.watch_engine(self.engine)
         # QoS control plane (gubernator_tpu/qos/): admission, congestion

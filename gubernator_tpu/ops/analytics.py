@@ -127,26 +127,34 @@ def shard_stats(sketch, packed, words, tenants, expire, now, decay, *,
 
     # Dense per-slot aggregation of THIS drain (O(C) scratch, like the
     # fused path's plane conversion — amortized over all K windows).
-    zeros = jnp.zeros((C,), jnp.int64)
-    dense_h = zeros.at[cslot].add(d.hits.ravel())
-    dense_o = zeros.at[cslot].add(d.over.ravel())
-    touched = zeros.at[cslot].add(d.occupied.ravel())
+    # ONE [C, 3] scatter-add instead of three [C] ones: integer adds are
+    # exact and per-column independent, so the split arrays are
+    # bit-identical to the oracle's three np.add.at passes — at a third
+    # of the executed scatter kernels.
+    dense = jnp.zeros((C, 3), jnp.int64).at[cslot].add(
+        jnp.stack([d.hits.ravel(), d.over.ravel(), d.occupied.ravel()],
+                  axis=-1))
+    dense_h, dense_o, touched = dense[:, 0], dense[:, 1], dense[:, 2]
     dense_w = dense_h + over_weight * dense_o
 
     # Count-min update: decay-by-halving (decay is 0 or 1, so `>>` is a
     # no-op on the hot path — no branch), then scatter-add the drain's
-    # per-slot weights into each hashed row.
+    # per-slot weights into each hashed row.  All D rows go in ONE flat
+    # [D*W] scatter (row r offset by r*W, so rows can never collide) —
+    # same per-bucket integer sums as the oracle's per-row np.add.at
+    # loop, D-fold fewer scatter/gather kernels.
+    D, W = sketch.shape
     all_slots = jnp.arange(C, dtype=jnp.int64)
-    rows, ests = [], []
-    for r in range(sketch.shape[0]):
-        h = hash_slots(jnp, all_slots, r, sketch.shape[1])
-        row = (sketch[r] >> decay).at[h].add(dense_w)
-        rows.append(row)
-        ests.append(row[h])
-    new_sketch = jnp.stack(rows)
-    est = ests[0]
-    for e in ests[1:]:
-        est = jnp.minimum(est, e)  # count-min: min over rows
+    rr = jnp.arange(D, dtype=jnp.int64)[:, None]
+    mults = jnp.asarray([_MULTS[r % MAX_SKETCH_DEPTH] for r in range(D)],
+                        jnp.int64)[:, None]
+    x = ((all_slots[None, :] + 1 + rr) * mults) & _MASK62
+    x = x ^ (x >> 31)
+    h = x % W  # [D, C] — hash_slots for every row at once
+    flat = (sketch >> decay).ravel().at[(rr * W + h).ravel()].add(
+        jnp.broadcast_to(dense_w, (D, C)).ravel())
+    new_sketch = flat.reshape(D, W)
+    est = jnp.min(jnp.take_along_axis(new_sketch, h, axis=1), axis=0)
 
     # Candidates: slots touched this drain, ranked by cumulative estimate.
     score = jnp.where(touched > 0, est, jnp.int64(-1))
@@ -160,13 +168,11 @@ def shard_stats(sketch, packed, words, tenants, expire, now, decay, *,
     ], axis=-1)
 
     # Per-tenant rows (host staged ids; clip defends against garbage).
+    # Same one-scatter shape as `dense` above.
     t = jnp.clip(tenants.astype(jnp.int64), 0, tenant_slots - 1).ravel()
-    tz = jnp.zeros((tenant_slots,), jnp.int64)
-    trows = jnp.stack([
-        tz.at[t].add(d.occupied.ravel()),
-        tz.at[t].add(d.hits.ravel()),
-        tz.at[t].add(d.over.ravel()),
-    ], axis=-1)
+    trows = jnp.zeros((tenant_slots, TENANT_COLS), jnp.int64).at[t].add(
+        jnp.stack([d.occupied.ravel(), d.hits.ravel(), d.over.ravel()],
+                  axis=-1))
 
     lanes = d.occupied.sum()
     over = d.over.sum()

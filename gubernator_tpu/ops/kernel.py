@@ -333,76 +333,82 @@ def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh,
     return _Reg(*new_reg), WindowOutput(*out)
 
 
-def uniform_closed_form(st: _Reg, fresh0, h0, l0, d0, a0, pos, seg_len, now):
-    """Closed form of a UNIFORM segment (every lane same hits>0/config):
-    the greedy use-it-or-lose-it sequence decrements for the first
-    k* = min(len, r_start // h) lanes and rejects the rest without
-    mutating — matching algorithms.go:51-65/:136-148 item by item.
+def fold_entering(reg: _Reg, fresh0, h0, l0, d0, a0, pos, nz, n_lead,
+                  hstar, now):
+    """Closed-form ENTERING register for lane `pos` of a foldable segment
+    (fold_classify's class): every nonzero hit in the segment equals
+    `hstar`, config is uniform, no AGG lanes.  Reconstructing the register
+    each lane would see lets ONE shared `transition` call replace the
+    whole lane-by-lane replay — the generalization of the old
+    uniform-segment closed form to mixed read/hit segments.
 
-    `st` is the segment's live register REPLICATED to every lane (the lane's
-    own segment-start register); all math is elementwise over lanes, which
-    is what lets the Pallas lowering (ops/pallas_kernel.py) run it in one
-    VMEM-resident pass.  Returns (final register, per-lane outputs)."""
-    is_token0 = a0 == TOKEN_BUCKET
-    init_over0 = h0 > l0
-    # dtype-generic like transition: i64 normally, rebased i32 on the
-    # Pallas TPU path
-    Z = jnp.asarray(0, h0.dtype)
-    ONE = jnp.asarray(1, h0.dtype)
+    The sequential recurrence folds because only three things evolve lane
+    to lane: the balance (token: minus hstar per accept, accepts =
+    min(#prior nonzero lanes, balance // hstar) by the greedy ladder;
+    leaky: plus one read-leak per leading read, saturating at the limit,
+    then the same accept arithmetic), the leaky tstamp (jumps to `now` at
+    the first nonzero lane and freezes — so the read-leak is the SAME
+    leak0 every application), and the leaky expiry (re-arms iff any
+    generic decrement happened).  `st`/`reg` is the segment-start register
+    replicated to every lane; all math is elementwise, i64 or rebased-i32
+    exactly like transition.
 
-    L_eff = jnp.where(fresh0, l0, st.limit)
-    D_eff = jnp.where(fresh0, d0, st.duration)
-    # token: reset_time is now+duration on init, stored otherwise
-    T0_tok = jnp.where(fresh0, now + d0, st.tstamp)
+    `nz` — exclusive count of nonzero-hit lanes before `pos` in-segment;
+    `n_lead` — leading zero-hit lanes; `hstar` — the shared nonzero hits
+    (0 if the segment is all reads).  All from fold_classify."""
+    dt = hstar.dtype
+    Z = jnp.asarray(0, dt)
+    ONE = jnp.asarray(1, dt)
+    is_tok = a0 == TOKEN_BUCKET
+    # init path image: over-limit init stores a drained balance
+    over0 = fresh0 & (h0 > l0)
+    L_eff = jnp.where(fresh0, l0, reg.limit)
+    D_eff = jnp.where(fresh0, d0, reg.duration)
+    nzd = nz.astype(dt)
+
+    # ---- token: balance only moves on accepts, T/E never move on hits ----
+    Rt = jnp.where(fresh0, jnp.where(over0, Z, l0), reg.remaining)
+    kt = jnp.minimum(nzd, Rt // jnp.maximum(hstar, ONE))
+    entR_tok = Rt - hstar * kt
+    T_tok = jnp.where(fresh0, now + d0, reg.tstamp)
+    E_tok = jnp.where(fresh0, now + d0, reg.expire)
+
+    # ---- leaky: leading reads each re-apply the SAME leak0 (tstamp is
+    # frozen until the first nonzero hit), saturating at the limit ----
     rate0 = jnp.maximum(D_eff // jnp.maximum(l0, ONE), ONE)
-    leak0 = jnp.where(fresh0, Z, (now - st.tstamp) // rate0)
-    r_start_tok = jnp.where(
-        fresh0, jnp.where(init_over0, Z, l0), st.remaining)
-    r_start_lky = jnp.where(
-        fresh0,
-        jnp.where(init_over0, Z, l0),
-        # add-after-min (equivalent given remaining <= limit): no i32
-        # overflow on remaining + leak
-        st.remaining + jnp.minimum(leak0, L_eff - st.remaining),
-    )
-    r_start = jnp.where(is_token0, r_start_tok, r_start_lky)
-    kstar = jnp.minimum(seg_len.astype(h0.dtype), r_start // h0)
-    r_end = r_start - kstar * h0
+    leak0 = jnp.where(fresh0, Z, (now - reg.tstamp) // rate0)
+    gap = L_eff - reg.remaining
+    # first application count that saturates; while p < p_sat the product
+    # p*leak0 < gap, so it cannot overflow the lane dtype
+    p_sat = jnp.where(leak0 > Z,
+                      (gap + leak0 - ONE) // jnp.maximum(leak0, ONE),
+                      jnp.asarray(1 << 30, dt))
 
-    posl = pos.astype(h0.dtype)
-    under = posl < kstar
-    ff_rem = jnp.where(under, r_start - (posl + 1) * h0, r_end)
-    ff_status = jnp.where(under, UNDER_LIMIT, OVER_LIMIT).astype(I32)
-    # leaky: UNDER lanes report 0; OVER lanes report now+rate — except the
-    # very first lane of a fresh bucket, whose init response is always 0
-    # (algorithms.go:169-181)
-    lky_reset = jnp.where(
-        under | (fresh0 & (pos == 0)), Z, now + rate0)
-    ff_reset = jnp.where(is_token0, T0_tok, lky_reset)
-    ff_out = WindowOutput(
-        status=ff_status, limit=L_eff, remaining=ff_rem, reset_time=ff_reset)
+    def satA(p):
+        return jnp.where(p >= p_sat, L_eff, reg.remaining + p * leak0)
 
-    # Leaky expiry extends only on GENERIC decrements (algorithms.go:
-    # 155-157) — the exact-drain branch (:136-141) leaves it untouched.
-    # Within a uniform run a drain can only be the LAST consume (h ==
-    # remaining ⇔ r_end hits 0), so the generic count is kstar minus one
-    # when r_end == 0; extension happened iff that count >= 1.  (Caught
-    # by the hypothesis fuzz: a lone exact drain must NOT re-arm a long
-    # TTL with the request's shorter duration.)
-    extended = (kstar - (r_end == 0)) >= 1
-    ff_reg = _Reg(
+    posd = pos.astype(dt)
+    fh = n_lead.astype(dt)
+    # balance the FIRST nonzero lane's ladder starts from (its own
+    # in-transition leak included): fh leading reads + one more leak
+    Rh = jnp.where(fresh0, jnp.where(over0, Z, l0), satA(fh + ONE))
+    Kf = Rh // jnp.maximum(hstar, ONE)
+    kl = jnp.minimum(nzd, Kf)
+    # the k-th accept is an exact drain (not generic) iff it lands on 0
+    drained = (hstar > Z) & (Rh == Kf * hstar) & (kl == Kf) & (kl >= ONE)
+    gen = kl - drained.astype(dt)
+    phaseA = ~fresh0 & (nz == 0)
+    entR_lky = jnp.where(phaseA, satA(posd), Rh - hstar * kl)
+    T_lky = jnp.where(fresh0 | (nz > 0), now, reg.tstamp)
+    E_lky = jnp.where(fresh0 | (gen >= ONE), now + d0, reg.expire)
+    return _Reg(
         limit=L_eff,
         duration=D_eff,
-        remaining=r_end,
-        tstamp=jnp.where(is_token0, T0_tok, now),
-        expire=jnp.where(
-            is_token0,
-            jnp.where(fresh0, now + d0, st.expire),
-            jnp.where(fresh0 | extended, now + d0, st.expire),
-        ),
+        remaining=jnp.where(is_tok, entR_tok, entR_lky),
+        tstamp=jnp.where(is_tok, T_tok, T_lky),
+        expire=jnp.where(is_tok, E_tok, E_lky),
         algo=a0,
     )
-    return ff_reg, ff_out
 
 
 def segment_structure(s_slot, s_valid, s_init):
@@ -446,21 +452,78 @@ def segment_structure(s_slot, s_valid, s_init):
     return seg_start, seg_start_idx, pos, seg_len, commit_mask
 
 
-def segment_all(ok, seg_start_idx, seg_len):
-    """Per-lane: does EVERY lane of my segment satisfy `ok`?  Replicated to
-    all lanes of the segment.
+def segment_count(flag, seg_start_idx, seg_len):
+    """Per-lane: how many lanes of my segment satisfy `flag`?  Replicated
+    to all lanes of the segment (i32).
 
-    Cumsum range-count instead of a scatter-min (`.at[seg].min`): counts the
-    failing lanes inside [seg_start, seg_start+len) from an inclusive
+    Cumsum range-count instead of a scatter-add (`.at[seg].add`): counts
+    the flagged lanes inside [seg_start, seg_start+len) from an inclusive
     prefix sum — gather-only, so the SAME code runs in window_prep's XLA
     trace and inside the fused Pallas megakernel.
     """
-    bad = (~ok).astype(I32)
-    csum = jnp.cumsum(bad)
+    f = flag.astype(I32)
+    csum = jnp.cumsum(f)
     seg_end = seg_start_idx + seg_len - 1
-    n_bad = (jnp.take(csum, seg_end) - jnp.take(csum, seg_start_idx)
-             + jnp.take(bad, seg_start_idx))
-    return n_bad == 0
+    return (jnp.take(csum, seg_end) - jnp.take(csum, seg_start_idx)
+            + jnp.take(f, seg_start_idx))
+
+
+def segment_all(ok, seg_start_idx, seg_len):
+    """Per-lane: does EVERY lane of my segment satisfy `ok`?  Replicated to
+    all lanes of the segment."""
+    return segment_count(~ok, seg_start_idx, seg_len) == 0
+
+
+def fold_classify(s_hits, s_limit, s_duration, s_algo, s_agg,
+                  seg_start_idx, seg_len, h0, l0, d0, a0, fresh_seg, reg,
+                  now):
+    """Classify segments for the zero-replay fold and compute the per-lane
+    prefix facts fold_entering consumes.  Returns
+    (seg_fold, nz, n_lead, hstar), all replicated/aligned to lanes.
+
+    A segment folds when one shared `transition` call per lane reproduces
+    the sequential replay exactly:
+      * uniform config (limit/duration/algo match the segment head), no
+        AGG lanes, no negative hits;
+      * every nonzero hit equals hstar (the first nonzero lane's hits) —
+        reads (hits==0) may interleave anywhere;
+      * leaky non-fresh registers additionally need the stored invariant
+        remaining <= limit, and a non-negative read-leak whenever the
+        segment has leading reads (each read re-applies leak0, which only
+        telescopes when it saturates monotonically; a lone in-transition
+        leak — no leading reads — is exact for any sign).
+    Everything else (mixed distinct nonzero hits, mixed configs, AGG runs
+    in multi-lane segments, negative hits/limits on leaky) falls back to
+    the replay while_loop — rare shapes by construction, since the router
+    folds duplicate identical requests into AGG singletons already.
+    """
+    B = s_hits.shape[0]
+    dt = s_hits.dtype
+    Z = jnp.asarray(0, dt)
+    ONE = jnp.asarray(1, dt)
+    nonzero = s_hits != 0
+    nzf = nonzero.astype(I32)
+    csum = jnp.cumsum(nzf)
+    exc = csum - nzf
+    # exclusive in-segment nonzero-lane count before each lane
+    nz = exc - jnp.take(exc, seg_start_idx)
+    lead = ~nonzero & (nz == 0)
+    n_lead = segment_count(lead, seg_start_idx, seg_len)
+    first_nz = jnp.clip(seg_start_idx + n_lead, 0, B - 1)
+    hstar = jnp.where(n_lead < seg_len, jnp.take(s_hits, first_nz), Z)
+    lane_ok = ((s_limit == l0) & (s_duration == d0) & (s_algo == a0)
+               & ~s_agg & ((s_hits == Z) | (s_hits == hstar)))
+    cfg_ok = segment_all(lane_ok, seg_start_idx, seg_len)
+    fresh0 = fresh_seg | (a0 != reg.algo)
+    L_eff = jnp.where(fresh0, l0, reg.limit)
+    rate0 = jnp.maximum(jnp.where(fresh0, d0, reg.duration)
+                        // jnp.maximum(l0, ONE), ONE)
+    leak0 = jnp.where(fresh0, Z, (now - reg.tstamp) // rate0)
+    lky_ok = ((a0 == TOKEN_BUCKET) | fresh0
+              | ((reg.remaining <= L_eff)
+                 & ((leak0 >= Z) | (n_lead == 0))))
+    seg_fold = cfg_ok & (hstar >= Z) & lky_ok
+    return seg_fold, nz, n_lead, hstar
 
 
 class WindowPrep(NamedTuple):
@@ -488,7 +551,10 @@ class WindowPrep(NamedTuple):
     l0: jax.Array
     d0: jax.Array
     a0: jax.Array
-    seg_uniform: jax.Array
+    nz: jax.Array      # exclusive in-segment nonzero-hit lane count (i32)
+    n_lead: jax.Array  # leading zero-hit lanes per segment, replicated
+    hstar: jax.Array   # the segment's shared nonzero hits (0: all reads)
+    seg_fold: jax.Array  # zero-replay foldable segment (fold_classify)
     max_pos: jax.Array
     commit_mask: jax.Array  # lanes whose register commits to the arena
     s_agg: jax.Array   # aggregated-run lanes (AGG_SLOT_BIT), sorted order
@@ -566,10 +632,11 @@ def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
     # per-round against the live register.
     cur_fresh = s_init | (cur.expire < now)
 
-    # Uniform-segment classification: a hot key's duplicates are usually
-    # identical requests (same hits>0 and config); those take the closed
-    # form (uniform_closed_form).  Only *irregular* segments (mixed
-    # hits/config, zero-hit reads) replay — is_init lanes can't appear
+    # Fold classification: a hot key's duplicates are usually identical
+    # requests (same hits and config, reads interleaved anywhere); those
+    # take the zero-replay closed form (fold_classify / fold_entering).
+    # Only *irregular* segments (mixed distinct nonzero hits, mixed
+    # config, AGG-in-multi-lane) replay — is_init lanes can't appear
     # mid-segment anymore (they start their own virtual segment above).
     # Segment-start replication: one packed row gather instead of five.
     packed_seg = jnp.stack(
@@ -581,24 +648,21 @@ def window_prep(state: BucketState, batch: WindowBatch, now) -> WindowPrep:
     d0 = seg0[:, 2]
     a0 = seg0[:, 3].astype(I32)
     fresh_seg = seg0[:, 4].astype(jnp.bool_)
-    lane_ok = (
-        (s_hits == h0) & (s_limit == l0) & (s_duration == d0)
-        & (s_algo == a0) & ~s_agg
-    )
-    seg_uniform = segment_all(lane_ok, seg_start_idx, seg_len) & (h0 > 0)
-    # A singleton non-uniform segment — a folded (aggregated-run) lane
-    # owning its slot this window, or a lone hits=0 peek — is closed-form
-    # too: its one replay round would read exactly the window-entry
-    # register, so window_step hoists that same transition call out of
-    # the loop and it must not force replay trips here.
-    seg_single = s_valid & ~seg_uniform & (seg_len == 1)
-    max_pos = jnp.max(jnp.where(s_valid & ~seg_uniform & ~seg_single, pos,
+    seg_fold, nz, n_lead, hstar = fold_classify(
+        s_hits, s_limit, s_duration, s_algo, s_agg, seg_start_idx,
+        seg_len, h0, l0, d0, a0, fresh_seg, cur, now)
+    # A singleton non-fold segment — an aggregated-run lane owning its
+    # slot this window, or a lone irregular lane — needs no replay trips
+    # either: its one round reads exactly the window-entry register, which
+    # the shared pos==0 transition in window_math covers.
+    seg_single = s_valid & ~seg_fold & (seg_len == 1)
+    max_pos = jnp.max(jnp.where(s_valid & ~seg_fold & ~seg_single, pos,
                                 jnp.int32(-1)))
 
     return WindowPrep(order, s_slot, s_valid, s_hits, s_limit, s_duration,
                       s_algo, s_init, seg_start, seg_start_idx, pos,
-                      seg_len, cur, fresh_seg, h0, l0, d0, a0, seg_uniform,
-                      max_pos, commit_mask, s_agg)
+                      seg_len, cur, fresh_seg, h0, l0, d0, a0, nz, n_lead,
+                      hstar, seg_fold, max_pos, commit_mask, s_agg)
 
 
 def window_commit(state: BucketState, prep: WindowPrep, fin: _Reg,
@@ -635,6 +699,109 @@ def window_commit(state: BucketState, prep: WindowPrep, fin: _Reg,
     return new_state, unsorted
 
 
+def window_math(now, max_pos, s_valid, s_hits, s_limit, s_duration,
+                s_algo, s_agg, pos, seg_len, seg_start_idx, seg_fold,
+                h0, l0, d0, a0, fresh_seg, reg, nz, n_lead, hstar):
+    """One pass over the sorted window: ONE shared transition call covers
+    every lane of foldable segments (entering registers reconstructed in
+    closed form by fold_entering) plus every singleton and pos-0 lane,
+    then replay rounds run only for the residual irregular segments.
+    Pure function of [B] lane vectors — the SAME body runs as a Pallas
+    VMEM kernel (ops/pallas_kernel.py), as plain traced XLA in rebased
+    int32 (the engine's compact serving default), and as the int64 oracle
+    (window_step below), so the three lowerings cannot drift.
+
+    Register state is REPLICATED at every lane of its segment (the arena
+    gather outside already yields that), so a replay round is elementwise
+    plus ONE vector gather — `computed[seg_start + p]` pulls the active
+    lane's freshly-computed register back to every lane of its segment —
+    with no scatters.
+
+    Returns (out_sorted: WindowOutput, fin: _Reg) with fin already
+    fold-vs-replayed selected (replicated; commit reads any lane).
+    """
+    B = pos.shape[0]
+    valid = s_valid
+    p_arr = pos
+    sidx = seg_start_idx
+    fresh0 = fresh_seg | (a0 != reg.algo)
+    seg_single = valid & ~seg_fold & (seg_len == 1)
+    covered = seg_fold | seg_single
+
+    # ---- the shared ladder: every covered lane in ONE transition ----
+    # pos-0 lanes (any segment kind) see the RAW stored register — the
+    # ladder's own init/expiry paths are the ground truth there, which is
+    # exactly what the old hoisted singleton call computed.
+    ent = fold_entering(reg, fresh0, h0, l0, d0, a0, p_arr, nz, n_lead,
+                        hstar, now)
+    first = p_arr == 0
+    ent = _Reg(*[jnp.where(first, r, e) for r, e in zip(reg, ent)])
+    ent_fresh = first & (fresh_seg | (s_algo != reg.algo))
+    new_reg, f_out = transition(ent, s_hits, s_limit, s_duration, s_algo,
+                                now, ent_fresh, agg=s_agg)
+    # a fold segment's committed register is its LAST lane's result
+    eidx = jnp.clip(sidx + seg_len - 1, 0, B - 1)
+    fin_cov = _Reg(*[jnp.take(x, eidx) for x in new_reg])
+
+    # ---- replay rounds for residual irregular segments ----
+    def body(carry):
+        p, lim, dur, rem, ts, exp, alg, fr, ost, oli, ore, ors = carry
+        r = _Reg(limit=lim, duration=dur, remaining=rem, tstamp=ts,
+                 expire=exp, algo=alg)
+        # is_init lanes start their own virtual segment, so their
+        # freshness is carried by fr (fresh_seg) until their round clears
+        # it — no per-lane s_init term needed
+        fresh = fr | (s_algo != r.algo)
+        new_r, resp = transition(
+            r, s_hits, s_limit, s_duration, s_algo, now, fresh,
+            agg=s_agg)
+        active = (p_arr == p) & valid & ~covered
+        # Propagate the active lane's result to its WHOLE segment (the
+        # final commit reads replicated registers).  ai = my segment
+        # start + p; active[ai] holds iff pos[ai] == p, which
+        # algebraically forces sidx[ai] == my sidx — i.e. ai really is MY
+        # segment's round-p lane (the clamp cannot false-positive:
+        # pos[B-1] == p with a clamped ai would need sidx + p > B-1 and
+        # sidx + p == B-1 at once).
+        ai = jnp.clip(sidx + p, 0, B - 1)
+        take = jnp.take(active, ai)
+
+        def upd(new, old):
+            return jnp.where(take, jnp.take(new, ai), old)
+
+        lim = upd(new_r.limit, lim)
+        dur = upd(new_r.duration, dur)
+        rem = upd(new_r.remaining, rem)
+        ts = upd(new_r.tstamp, ts)
+        exp = upd(new_r.expire, exp)
+        alg = jnp.where(take, jnp.take(new_r.algo, ai), alg)
+        fr = jnp.where(take, False, fr)
+        ost = jnp.where(active, resp.status, ost)
+        oli = jnp.where(active, resp.limit, oli)
+        ore = jnp.where(active, resp.remaining, ore)
+        ors = jnp.where(active, resp.reset_time, ors)
+        return (p + 1, lim, dur, rem, ts, exp, alg, fr, ost, oli, ore, ors)
+
+    init = (jnp.int32(0), reg.limit, reg.duration, reg.remaining,
+            reg.tstamp, reg.expire, reg.algo, fresh0,
+            f_out.status, f_out.limit, f_out.remaining, f_out.reset_time)
+    carry = lax.while_loop(lambda c: c[0] <= max_pos, body, init)
+    (_, lim, dur, rem, ts, exp, alg, _, ost, oli, ore, ors) = carry
+
+    # replay rounds never touch covered lanes, so the loop's output
+    # buffers (seeded from the shared ladder) are already complete
+    out_sorted = WindowOutput(status=ost, limit=oli, remaining=ore,
+                              reset_time=ors)
+    fin = _Reg(
+        limit=jnp.where(covered, fin_cov.limit, lim),
+        duration=jnp.where(covered, fin_cov.duration, dur),
+        remaining=jnp.where(covered, fin_cov.remaining, rem),
+        tstamp=jnp.where(covered, fin_cov.tstamp, ts),
+        expire=jnp.where(covered, fin_cov.expire, exp),
+        algo=jnp.where(covered, fin_cov.algo, alg))
+    return out_sorted, fin
+
+
 def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketState, WindowOutput]:
     """Apply one window of requests to the arena; returns (new_state, responses).
 
@@ -642,93 +809,20 @@ def window_step(state: BucketState, batch: WindowBatch, now) -> tuple[BucketStat
     item-by-item under the cache mutex (gubernator.go:210-227,236-251), but as
     one device computation.  Responses are positionally aligned with the batch
     (the reference demuxes by index, peers.go:204-207).
+
+    This is the int64 oracle: prep → window_math → commit, the same three
+    stages every other lowering (compact32 XLA, Pallas, fused megakernel)
+    composes, in full-width arithmetic.
     """
-    B = batch.slot.shape[0]
     now = jnp.asarray(now, dtype=I64)
-
     prep = window_prep(state, batch, now)
-    (order, s_slot, s_valid, s_hits, s_limit, s_duration, s_algo, s_init,
-     seg_start, seg_start_idx, pos, seg_len, cur, fresh_seg, h0, l0, d0,
-     a0, seg_uniform, max_pos, _commit_mask, s_agg) = prep
-    cur_fresh = s_init | (cur.expire < now)
-
-    # Registers travel PACKED as one [B, 7] row array (the seventh column
-    # is the per-lane fresh flag): the closed-form segment gather and every
-    # replay round are then one row gather + one row scatter instead of
-    # 6-7 per-field launches — per-op launch cost is a measured fixed cost
-    # on remote runtimes (BENCH_NOTES round 4).
-    def pack_reg(reg, fresh):
-        return jnp.stack(
-            [reg.limit, reg.duration, reg.remaining, reg.tstamp,
-             reg.expire, reg.algo.astype(I64), fresh.astype(I64)], axis=-1)
-
-    def unpack_reg(rows):
-        return _Reg(limit=rows[:, 0], duration=rows[:, 1],
-                    remaining=rows[:, 2], tstamp=rows[:, 3],
-                    expire=rows[:, 4],
-                    algo=rows[:, 5].astype(I32)), rows[:, 6] != 0
-
-    cur_packed = pack_reg(cur, cur_fresh)
-    st, st_fresh = unpack_reg(cur_packed[seg_start_idx])
-    fresh0 = fresh_seg | (a0 != st.algo)
-    ff_reg, ff_out = uniform_closed_form(
-        st, fresh0, h0, l0, d0, a0, pos, seg_len, now)
-
-    # Singleton non-uniform segments (a folded lane owning its slot this
-    # window — the fold's normal shape — or a lone hits=0 peek): their one
-    # replay round reads exactly the window-entry register, so hoist the
-    # SAME transition call (same inputs) to straight line.  It fuses with
-    # the ladder above, and a fold-only window runs ZERO replay trips
-    # (window_prep's max_pos already excludes these lanes).
-    seg_single = s_valid & ~seg_uniform & (seg_len == 1)
-    a_reg, a_out = transition(st, s_hits, s_limit, s_duration, s_algo,
-                              now, st_fresh | (s_algo != st.algo),
-                              agg=s_agg)
-
-    # replay buffers start from the fast-path answers; replay rounds only
-    # overwrite lanes of non-uniform segments
-    outs = ff_out
-
-    def round_body(carry):
-        p, cur_packed, outs = carry
-        active = (pos == p) & s_valid & ~seg_uniform & ~seg_single
-        reg, reg_fresh = unpack_reg(cur_packed[seg_start_idx])
-        # fresh: segment-level miss (expired/new/init at window start — an
-        # is_init lane always starts its own virtual segment, so its flag
-        # is carried in the packed rows until its round clears it) or an
-        # algorithm switch against the live register.
-        fresh = reg_fresh | (s_algo != reg.algo)
-        new_reg, resp = transition(reg, s_hits, s_limit, s_duration, s_algo,
-                                   now, fresh, agg=s_agg)
-        # One active lane per segment → scatter back is collision-free.
-        widx = jnp.where(active, seg_start_idx, jnp.int32(B))
-        cur_packed = cur_packed.at[widx].set(
-            pack_reg(new_reg, jnp.zeros_like(fresh)), mode="drop")
-        outs = WindowOutput(*jax.tree.map(
-            lambda o, r: jnp.where(active, r, o), outs, resp
-        ))
-        return p + 1, cur_packed, outs
-
-    def round_cond(carry):
-        p = carry[0]
-        return p <= max_pos
-
-    _, cur_packed, outs = lax.while_loop(
-        round_cond, round_body, (jnp.int32(0), cur_packed, outs)
-    )
-    cur, _ = unpack_reg(cur_packed)
-
-    outs = WindowOutput(*jax.tree.map(
-        lambda a, o: jnp.where(seg_single, a, o), a_out, outs))
-
-    # Uniform segments commit their closed-form state; replayed segments
-    # commit the live register (one write per touched slot — the window's
-    # net effect, like the mutex-serialized mutations).
-    fin = _Reg(*jax.tree.map(
-        lambda f, c: jnp.where(seg_uniform, f, c), ff_reg, cur))
-    fin = _Reg(*jax.tree.map(
-        lambda a, f: jnp.where(seg_single, a, f), a_reg, fin))
-    return window_commit(state, prep, fin, outs)
+    out_sorted, fin = window_math(
+        now, prep.max_pos, prep.s_valid, prep.s_hits, prep.s_limit,
+        prep.s_duration, prep.s_algo, prep.s_agg, prep.pos, prep.seg_len,
+        prep.seg_start_idx, prep.seg_fold, prep.h0, prep.l0, prep.d0,
+        prep.a0, prep.fresh_seg, prep.cur, prep.nz, prep.n_lead,
+        prep.hstar)
+    return window_commit(state, prep, fin, out_sorted)
 
 
 def pack_outputs(out: WindowOutput, gout: WindowOutput) -> jax.Array:
@@ -945,3 +1039,47 @@ def global_apply(state: BucketState, cfg: GlobalConfig, summed_hits: jax.Array, 
     touched = summed_hits != 0
     merged = jax.tree.map(lambda n, o: jnp.where(touched, n, o), new_reg, reg)
     return BucketState(*merged)
+
+
+def global_combined(state: BucketState, cfg: GlobalConfig, batch: WindowBatch,
+                    summed_hits: jax.Array, now
+                    ) -> tuple[BucketState, WindowOutput]:
+    """global_read + global_apply as ONE transition over concatenated lanes.
+
+    Sequentially the GLOBAL window is two separate transition ladders —
+    the Bg replica reads, then the G-wide aggregate apply — which doubles
+    the sub-window's executed-kernel count for no data-dependence reason:
+    reads never mutate and by construction see the PRE-apply replica
+    (global_read runs before the psum lands).  Stacking both lane sets
+    into one [Bg+G] batch runs the shared state machine once; the read
+    half's register outputs and the apply half's response outputs are
+    simply discarded, exactly as the standalone calls discard them.
+    Bit-exact with global_read followed by global_apply because transition
+    is purely lane-wise.  Returns (new_state, read_outputs).
+    """
+    C = state.limit.shape[0]
+    now = jnp.asarray(now, dtype=I64)
+    g = jnp.clip(batch.slot, 0, C - 1)
+    reg = _Reg(*state)
+    r_reg = _Reg(*[x[g] for x in state])
+    r_fresh = (batch.is_init | (r_reg.expire < now)
+               | (batch.algo != r_reg.algo))
+    a_fresh = (reg.expire < now) | (cfg.algo != reg.algo)
+    cat = lambda a, b: jnp.concatenate([a, b], axis=0)
+    ent = _Reg(*[cat(r, s) for r, s in zip(r_reg, reg)])
+    new_reg, out = transition(
+        ent,
+        cat(jnp.where(r_fresh, batch.hits, jnp.int64(0)), summed_hits),
+        cat(batch.limit, cfg.limit),
+        cat(batch.duration, cfg.duration),
+        cat(batch.algo, cfg.algo),
+        now,
+        cat(r_fresh, a_fresh),
+    )
+    Bg = batch.slot.shape[0]
+    read_out = WindowOutput(*[o[:Bg] for o in out])
+    apply_reg = _Reg(*[r[Bg:] for r in new_reg])
+    touched = summed_hits != 0
+    merged = jax.tree.map(lambda n, o: jnp.where(touched, n, o),
+                          apply_reg, reg)
+    return BucketState(*merged), read_out

@@ -198,114 +198,18 @@ def global_apply_pallas(state: BucketState, cfg: GlobalConfig,
 # ---- the serving window kernel ------------------------------------------
 
 
-def _window_math(now, max_pos, s_valid, s_hits, s_limit, s_duration,
-                 s_algo, s_agg, pos, seg_len, seg_start_idx, seg_uniform,
-                 h0, l0, d0, a0, fresh_seg, reg):
-    """One pass over the sorted window: closed-form uniform segments, then
-    replay rounds for irregular ones.  Pure function of [B] lane vectors —
-    the SAME body runs as a Pallas VMEM kernel (via _window_math_kernel)
-    and as plain traced XLA (window_step_compact(..., use_pallas=False)),
-    in either int64 or rebased-int32 form.
-
-    Register state is REPLICATED at every lane of its segment (the arena
-    gather outside already yields that: all lanes of a segment load the
-    same slot), so a replay round is elementwise plus ONE vector gather —
-    `computed[seg_start + p]` pulls the active lane's freshly-computed
-    register back to every lane of its segment — with no scatters.
-
-    Returns (out_sorted: WindowOutput, fin: _Reg) with fin already
-    uniform-vs-replayed selected.
-    """
-    B = pos.shape[0]
-    fresh0 = fresh_seg
-    uniform = seg_uniform
-    valid = s_valid
-    p_arr = pos
-    sidx = seg_start_idx
-
-    # ---- closed form for uniform segments (replicated-register form) ----
-    ff_reg, ff_out = kernel.uniform_closed_form(
-        reg, fresh0 | (a0 != reg.algo), h0, l0, d0, a0,
-        p_arr, seg_len, now)
-
-    # ---- singleton non-uniform segments: whole-run closed form ----
-    # A folded lane that owns its slot in this window (the fold's normal
-    # shape) or a lone hits=0 peek gets EXACTLY what its one replay round
-    # would compute — same transition call, same inputs — hoisted to
-    # straight line (it fuses with the ladder above; a fold-only window
-    # then runs ZERO replay trips, prep's max_pos excludes these lanes).
-    seg_single = valid & ~uniform & (seg_len == 1)
-    a_reg, a_out = kernel.transition(
-        reg, s_hits, s_limit, s_duration, s_algo, now,
-        fresh0 | (s_algo != reg.algo), agg=s_agg)
-
-    # ---- replay rounds for irregular segments ----
-    def body(carry):
-        p, lim, dur, rem, ts, exp, alg, fr, ost, oli, ore, ors = carry
-        r = _Reg(limit=lim, duration=dur, remaining=rem, tstamp=ts,
-                 expire=exp, algo=alg)
-        # is_init lanes start their own virtual segment, so their
-        # freshness is carried by fr (fresh_seg) until their round clears
-        # it — no per-lane s_init term needed
-        fresh = fr | (s_algo != r.algo)
-        new_r, resp = kernel.transition(
-            r, s_hits, s_limit, s_duration, s_algo, now, fresh,
-            agg=s_agg)
-        active = (p_arr == p) & valid & ~uniform & ~seg_single
-        # Propagate the active lane's result to its WHOLE segment (the
-        # final commit reads registers at segment-start lanes, pos 0).
-        # ai = my segment start + p; active[ai] holds iff pos[ai] == p,
-        # which algebraically forces sidx[ai] == my sidx — i.e. ai really
-        # is MY segment's round-p lane (the clamp cannot false-positive:
-        # pos[B-1] == p with a clamped ai would need sidx + p > B-1 and
-        # sidx + p == B-1 at once).
-        ai = jnp.clip(sidx + p, 0, B - 1)
-        take = jnp.take(active, ai)
-
-        def upd(new, old):
-            return jnp.where(take, jnp.take(new, ai), old)
-
-        lim = upd(new_r.limit, lim)
-        dur = upd(new_r.duration, dur)
-        rem = upd(new_r.remaining, rem)
-        ts = upd(new_r.tstamp, ts)
-        exp = upd(new_r.expire, exp)
-        alg = jnp.where(take, jnp.take(new_r.algo, ai), alg)
-        fr = jnp.where(take, False, fr)
-        ost = jnp.where(active, resp.status, ost)
-        oli = jnp.where(active, resp.limit, oli)
-        ore = jnp.where(active, resp.remaining, ore)
-        ors = jnp.where(active, resp.reset_time, ors)
-        return (p + 1, lim, dur, rem, ts, exp, alg, fr, ost, oli, ore, ors)
-
-    init = (jnp.int32(0), reg.limit, reg.duration, reg.remaining,
-            reg.tstamp, reg.expire, reg.algo, fresh0,
-            ff_out.status, ff_out.limit, ff_out.remaining,
-            ff_out.reset_time)
-    carry = lax.while_loop(lambda c: c[0] <= max_pos, body, init)
-    (_, lim, dur, rem, ts, exp, alg, _, ost, oli, ore, ors) = carry
-
-    out_sorted = WindowOutput(
-        status=jnp.where(seg_single, a_out.status, ost),
-        limit=jnp.where(seg_single, a_out.limit, oli),
-        remaining=jnp.where(seg_single, a_out.remaining, ore),
-        reset_time=jnp.where(seg_single, a_out.reset_time, ors))
-    fin = _Reg(
-        limit=jnp.where(uniform, ff_reg.limit, lim),
-        duration=jnp.where(uniform, ff_reg.duration, dur),
-        remaining=jnp.where(uniform, ff_reg.remaining, rem),
-        tstamp=jnp.where(uniform, ff_reg.tstamp, ts),
-        expire=jnp.where(uniform, ff_reg.expire, exp),
-        algo=jnp.where(uniform, ff_reg.algo, alg))
-    fin = _Reg(*jax.tree.map(
-        lambda a, f: jnp.where(seg_single, a, f), a_reg, fin))
-    return out_sorted, fin
+# The one window-math body — the generalized zero-replay fold plus the
+# residual replay loop — lives in ops/kernel.py (window_math) so the
+# int64 oracle, the compact32 XLA path, the per-window Pallas kernel and
+# the fused megakernel all run literally the same function.
+_window_math = kernel.window_math
 
 
 def _window_math_kernel(now_ref, maxpos_ref,
                         s_valid, s_hits, s_limit, s_duration, s_algo,
                         s_init, s_agg, pos, seg_len, seg_start_idx,
-                        seg_uniform, h0, l0, d0, a0, fresh_seg,
+                        seg_fold, h0, l0, d0, a0, fresh_seg, nz, n_lead,
+                        hstar,
                         r_lim, r_dur, r_rem, r_ts, r_exp, r_algo,
                         o_status, o_limit, o_rem, o_reset,
                         f_lim, f_dur, f_rem, f_ts, f_exp, f_algo):
@@ -315,8 +219,8 @@ def _window_math_kernel(now_ref, maxpos_ref,
     out_sorted, fin = _window_math(
         now_ref[0], maxpos_ref[0], s_valid[:], s_hits[:], s_limit[:],
         s_duration[:], s_algo[:], s_agg[:], pos[:], seg_len[:],
-        seg_start_idx[:], seg_uniform[:], h0[:], l0[:], d0[:], a0[:],
-        fresh_seg[:], reg)
+        seg_start_idx[:], seg_fold[:], h0[:], l0[:], d0[:], a0[:],
+        fresh_seg[:], reg, nz[:], n_lead[:], hstar[:])
     o_status[:] = out_sorted.status
     o_limit[:] = out_sorted.limit
     o_rem[:] = out_sorted.remaining
@@ -372,7 +276,7 @@ def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
     prep = kernel.window_prep(state, batch, now)
     (_, _, s_valid, s_hits, s_limit, s_duration, s_algo, s_init,
      _, seg_start_idx, pos, seg_len, cur, fresh_seg, h0, l0, d0, a0,
-     seg_uniform, max_pos, _commit_mask, s_agg) = prep
+     nz, n_lead, hstar, seg_fold, max_pos, _commit_mask, s_agg) = prep
 
     if compact32:
         lim = jnp.int64(2**31 - 16)
@@ -380,6 +284,7 @@ def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
         cnt = lambda x: x.astype(I32)
         k_hits, k_limit, k_dur = cnt(s_hits), cnt(s_limit), cnt(s_duration)
         k_h0, k_l0, k_d0 = cnt(h0), cnt(l0), cnt(d0)
+        k_hstar = cnt(hstar)
         k_cur = _Reg(limit=cnt(cur.limit), duration=cnt(cur.duration),
                      remaining=cnt(cur.remaining), tstamp=rel(cur.tstamp),
                      expire=rel(cur.expire), algo=cur.algo)
@@ -388,6 +293,7 @@ def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
     else:
         k_hits, k_limit, k_dur = s_hits, s_limit, s_duration
         k_h0, k_l0, k_d0 = h0, l0, d0
+        k_hstar = hstar
         k_cur = cur
         k_now = now.reshape((1,))
         VD = I64
@@ -404,7 +310,7 @@ def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
         sspec = pl.BlockSpec((1,), lambda: (0,))
         outs = pl.pallas_call(
             _window_math_kernel,
-            in_specs=[sspec, sspec] + [spec] * 22,
+            in_specs=[sspec, sspec] + [spec] * 25,
             out_specs=[spec] * 10,
             out_shape=[sds(I32), sds(VD), sds(VD), sds(VD),   # outputs
                        sds(VD), sds(VD), sds(VD), sds(VD), sds(VD),
@@ -412,8 +318,8 @@ def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
             interpret=interpret,
         )(k_now, max_pos.reshape((1,)),
           s_valid, k_hits, k_limit, k_dur, s_algo, s_init, s_agg,
-          pos, seg_len, seg_start_idx, seg_uniform,
-          k_h0, k_l0, k_d0, a0, fresh_seg,
+          pos, seg_len, seg_start_idx, seg_fold,
+          k_h0, k_l0, k_d0, a0, fresh_seg, nz, n_lead, k_hstar,
           k_cur.limit, k_cur.duration, k_cur.remaining, k_cur.tstamp,
           k_cur.expire, k_cur.algo)
         out_sorted = WindowOutput(status=outs[0], limit=outs[1],
@@ -423,8 +329,8 @@ def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
     else:
         out_sorted, fin = _window_math(
             k_now[0], max_pos, s_valid, k_hits, k_limit, k_dur, s_algo,
-            s_agg, pos, seg_len, seg_start_idx, seg_uniform,
-            k_h0, k_l0, k_d0, a0, fresh_seg, k_cur)
+            s_agg, pos, seg_len, seg_start_idx, seg_fold,
+            k_h0, k_l0, k_d0, a0, fresh_seg, k_cur, nz, n_lead, k_hstar)
     if compact32:
         # re-absolutize.  reset_time: leaky uses 0 as the "no reset"
         # sentinel and every leaky non-zero reset is now+rate with
@@ -642,19 +548,21 @@ def _fused_kernel(now_ref, req_ref,
     d0 = jnp.take(s_duration, seg_start_idx)
     a0 = jnp.take(s_algo, seg_start_idx)
     fresh_seg = jnp.take(cur_fresh, seg_start_idx)
-    lane_ok = ((s_hits == h0) & (s_limit == l0) & (s_duration == d0)
-               & (s_algo == a0) & ~s_agg)
-    seg_uniform = (kernel.segment_all(lane_ok, seg_start_idx, seg_len)
-                   & (h0 > 0))
-    seg_single = s_valid & ~seg_uniform & (seg_len == 1)
-    max_pos = jnp.max(jnp.where(s_valid & ~seg_uniform & ~seg_single, pos,
+    # fold classification in the rebased-i32 domain (cur is already
+    # rebased to now=0, so fold_classify's leak math matches the split
+    # paths' int64 classification under the compact caps)
+    seg_fold, nz, n_lead, hstar = kernel.fold_classify(
+        s_hits, s_limit, s_duration, s_algo, s_agg, seg_start_idx,
+        seg_len, h0, l0, d0, a0, fresh_seg, cur, jnp.int32(0))
+    seg_single = s_valid & ~seg_fold & (seg_len == 1)
+    max_pos = jnp.max(jnp.where(s_valid & ~seg_fold & ~seg_single, pos,
                                 jnp.int32(-1)))
 
     # ---- the window math: the SAME body as the split paths ----
     out_sorted, fin = _window_math(
         jnp.int32(0), max_pos, s_valid, s_hits, s_limit, s_duration,
-        s_algo, s_agg, pos, seg_len, seg_start_idx, seg_uniform,
-        h0, l0, d0, a0, fresh_seg, cur)
+        s_algo, s_agg, pos, seg_len, seg_start_idx, seg_fold,
+        h0, l0, d0, a0, fresh_seg, cur, nz, n_lead, hstar)
 
     # ---- commit: one write per touched slot, race-free scatter form ----
     # window_commit redirects non-commit lanes to slot C (out of range,

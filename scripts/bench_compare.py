@@ -23,6 +23,13 @@ jitter) on either gated metric fails the build loudly:
   * host_decisions_per_sec    the pipelined host path (RPC bytes -> C
                               parse -> stacked dispatch -> C encode)
 
+A fourth gate is ABSOLUTE and box-independent: `kernels_per_window`
+(the composed serving arm's executed-kernel census, recorded at the top
+level of the BENCH json) must stay within the kernel-ladder budget —
+>= 3x below the 192.5/window pre-ladder anchor.  The census is a
+property of the traced program, so no fingerprint, no stash, and no
+rebase applies to it.
+
 Prior BENCH_r*.json rounds are still read (defensively: rc != 0 or an
 empty `parsed` is skipped, CPU numbers may live at the top level or
 nested under `cpu_smoke`) but only for CONTEXT in the log — they carry
@@ -46,6 +53,15 @@ import sys
 
 GATED_METRICS = ("e2e_decisions_per_sec", "device_decisions_per_sec",
                  "host_decisions_per_sec")
+
+# Kernel-ladder budget (box-independent: the census is a property of the
+# traced program, identical on every host, so it gates ABSOLUTELY — no
+# host fingerprint, no stash, and GUBER_BENCH_REBASE does not bypass it).
+# Anchor = the pre-ladder composed serving window: 1257 drain kernels +
+# 283 analytics kernels over a K=8 stack = 192.5 kernels/window.  The
+# collapsed ladder must hold >= 3x below the anchor.
+CENSUS_ANCHOR_KPW = 192.5
+CENSUS_BUDGET_KPW = CENSUS_ANCHOR_KPW / 3.0
 
 
 def host_fingerprint() -> tuple[str, str]:
@@ -184,6 +200,23 @@ def compare(baseline: dict, fresh_cpu: dict, tolerance: float) -> list[str]:
     return failures
 
 
+def census_gate(fresh: dict) -> list[str]:
+    """Absolute kernels-per-window budget on the composed serving arm
+    (bench.py records it at the TOP level — box-independent)."""
+    kpw = fresh.get("kernels_per_window")
+    if not isinstance(kpw, (int, float)) or kpw <= 0:
+        print("  kernels_per_window: absent — census gate skipped")
+        return []
+    verdict = "OK" if kpw <= CENSUS_BUDGET_KPW else "REGRESSION"
+    print(f"  kernels_per_window: {kpw:.1f} vs budget "
+          f"{CENSUS_BUDGET_KPW:.1f} (anchor {CENSUS_ANCHOR_KPW:.1f} / 3) "
+          f"{verdict}")
+    if verdict != "OK":
+        return [f"kernels_per_window: {kpw:.1f} > {CENSUS_BUDGET_KPW:.1f} "
+                "— composed serving ladder regressed past the 3x budget"]
+    return []
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--bench-dir",
@@ -233,6 +266,15 @@ def main(argv=None) -> int:
     gated = {m: float(fresh_cpu[m]) for m in GATED_METRICS
              if isinstance(fresh_cpu.get(m), (int, float))
              and fresh_cpu[m] > 0}
+
+    # census gate first: absolute, host-independent, not rebasable
+    print("bench gate: kernel-census budget (box-independent)")
+    census_failures = census_gate(fresh)
+    if census_failures:
+        print("bench gate FAILED:", file=sys.stderr)
+        for f_ in census_failures:
+            print(f"  {f_}", file=sys.stderr)
+        return 1
 
     if rebase or not stash:
         if not gated:

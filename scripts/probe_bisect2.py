@@ -40,13 +40,15 @@ def v_closed_commit(state, pk, now):
     """Closed form + arena commit, replay while_loop SKIPPED: brackets the
     loop's fixed cost (full - this) and the commit scatters' cost
     (this - the first ladder's decode+prep+closed)."""
-    from gubernator_tpu.ops.kernel import _Reg
     bt = kernel.decode_batch(pk)
     prep = kernel.window_prep(state, bt, now)
-    st = _Reg(*jax.tree.map(lambda a: a[prep.seg_start_idx], prep.cur))
-    ff_reg, ff_out = kernel.uniform_closed_form(
-        st, prep.fresh_seg | (prep.a0 != st.algo), prep.h0, prep.l0,
-        prep.d0, prep.a0, prep.pos, prep.seg_len, now)
+    ent = kernel.fold_entering(
+        prep.cur, prep.fresh_seg | (prep.a0 != prep.cur.algo), prep.h0,
+        prep.l0, prep.d0, prep.a0, prep.pos, prep.nz, prep.n_lead,
+        prep.hstar, now)
+    ff_reg, ff_out = kernel.transition(
+        ent, prep.s_hits, prep.s_limit, prep.s_duration, prep.s_algo,
+        now, (prep.pos == 0) & prep.fresh_seg, agg=prep.s_agg)
     state, out = kernel.window_commit(state, prep, ff_reg, ff_out)
     return state, jnp.sum(out.remaining)
 

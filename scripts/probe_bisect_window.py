@@ -54,11 +54,13 @@ def v_prep(state, pk, now):
 def v_closed(state, pk, now):
     bt = kernel.decode_batch(pk)
     prep = kernel.window_prep(state, bt, now)
-    st = _Reg(*jax.tree.map(lambda a: a[prep.seg_start_idx], prep.cur))
-    fresh0 = (prep.fresh_seg | (prep.a0 != st.algo))
-    ff_reg, ff_out = kernel.uniform_closed_form(
-        st, fresh0, prep.h0, prep.l0, prep.d0, prep.a0, prep.pos,
-        prep.seg_len, now)
+    fresh0 = (prep.fresh_seg | (prep.a0 != prep.cur.algo))
+    ent = kernel.fold_entering(
+        prep.cur, fresh0, prep.h0, prep.l0, prep.d0, prep.a0, prep.pos,
+        prep.nz, prep.n_lead, prep.hstar, now)
+    ff_reg, ff_out = kernel.transition(
+        ent, prep.s_hits, prep.s_limit, prep.s_duration, prep.s_algo,
+        now, (prep.pos == 0) & fresh0, agg=prep.s_agg)
     s = jnp.sum(ff_out.remaining) + jnp.sum(ff_reg.remaining)
     return state, s
 

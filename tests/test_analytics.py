@@ -253,12 +253,12 @@ def test_analytics_metric_families_observed(inst_on):
 # ------------------------------------------------- zero-overhead census
 
 def test_drain_builders_untouched_by_analytics():
-    """Analytics must compose AROUND the drain, not inside it: enabling
-    it returns the very same cached drain executables (so the off-path
-    jaxprs are byte-identical by construction), and no drain builder
-    grows an analytics parameter."""
-    import inspect
-
+    """Enabling analytics must leave the analytics-OFF serving path
+    byte-identical: the default builders return the very same cached
+    executables before and after wiring, and the lockstep's
+    analytics-COMPOSED drain is a separate lru_cache entry keyed on the
+    config-level geometry — a new executable, never a mutation of the
+    plain one."""
     from gubernator_tpu.core import engine as engine_mod
 
     inst = Instance(_conf())
@@ -271,13 +271,72 @@ def test_drain_builders_untouched_by_analytics():
         inst.engine.enable_analytics(an)
         assert engine_mod._compiled_pipeline_step(mesh) is step_before
         assert engine_mod._compiled_pipeline_step_global(mesh) is global_before
-        for builder in (engine_mod._compiled_pipeline_step_impl,
-                        engine_mod._compiled_pipeline_step_global_impl):
-            params = inspect.signature(builder).parameters
-            assert not any("analytic" in p for p in params), (
-                f"{builder.__name__} grew an analytics parameter")
+        geom = (an.sketch_depth, an.sketch_width, an.tenant_slots,
+                an.topk, an.over_weight)
+        composed = engine_mod._compiled_pipeline_step_global(mesh, geom)
+        assert composed is not global_before
+        # the composed entry does not displace the plain one
+        assert engine_mod._compiled_pipeline_step_global(mesh) is global_before
+        assert engine_mod._compiled_pipeline_step_global(mesh, geom) is composed
     finally:
         inst.close()
+
+
+def test_lockstep_composes_analytics_into_drain():
+    """Lockstep ticks run the stats reduction INSIDE the composed drain
+    executable (engine.pipeline_dispatch_global analytics_args): the
+    separate reduce executable is never dispatched, yet the host rolling
+    table sees every decision with tenant attribution."""
+    from gubernator_tpu import native
+    if not native.available():
+        pytest.skip("native router unavailable")
+    from gubernator_tpu.config import BehaviorConfig
+    from gubernator_tpu.core.batcher import WindowBatcher
+    from gubernator_tpu.core.engine import RateLimitEngine
+    from gubernator_tpu.observability.analytics import TrafficAnalytics
+    from gubernator_tpu.parallel.distributed import LockstepClock
+    from gubernator_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(jax.devices()[:8])
+    eng = RateLimitEngine(mesh=mesh, capacity_per_shard=64,
+                          batch_per_shard=32, global_capacity=16,
+                          global_batch_per_shard=8, max_global_updates=8)
+    an_conf = AnalyticsConfig()
+    an_conf.enabled = True
+    an = TrafficAnalytics(an_conf)
+    eng.enable_analytics(an_conf)
+    clock = LockstepClock(NOW, 0.02)
+    b = WindowBatcher(eng, BehaviorConfig(batch_wait=0.02,
+                                          lockstep_stack=2),
+                      lockstep_clock=clock, analytics=an)
+    assert b.pipeline is not None and b.pipeline.lockstep
+    eng.warmup(now=NOW, k_stack=2)
+
+    def _no_separate(*a, **k):
+        raise AssertionError(
+            "lockstep must not dispatch the separate analytics reduce")
+    eng.analytics_dispatch = _no_separate
+
+    async def run():
+        b.start_lockstep()
+        # distinct keys: duplicate runs would FOLD into single AGG lanes
+        # and the reduction counts lanes, not folded decisions
+        reqs = [RateLimitReq(name=f"acct{i % 3}", unique_key=f"ak{i}",
+                             hits=1, limit=1 << 10, duration=60_000,
+                             algorithm=Algorithm.TOKEN_BUCKET)
+                for i in range(24)]
+        return await asyncio.gather(*(b.submit(r) for r in reqs))
+
+    try:
+        outs = asyncio.run(run())
+    finally:
+        b.close()
+    assert len(outs) == 24 and all(int(o.status) == 0 for o in outs)
+    snap = an.snapshot()
+    assert snap["totals"]["decisions"] == 24
+    assert sum(row["decisions"]
+               for row in snap["tenants"].values()) == 24
+    assert snap["totals"]["under_limit"] == 24
 
 
 def _count_drain_fetches(inst, reqs) -> int:

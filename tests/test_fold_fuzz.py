@@ -1,0 +1,144 @@
+"""Adversarial-segment fuzz for the generalized zero-replay fold.
+
+window_step evaluates each same-slot lane run (segment) either
+CLOSED-FORM — when fold_classify admits it — or through the per-segment
+replay; both must reproduce the sequential contract exactly: lanes
+applied one at a time in lane order, each seeing its predecessors'
+committed register.  The oracle here IS that contract: the same lanes
+re-dispatched as single-lane windows, where every segment has length 1
+and the fold prefix machinery is inert by construction.  Any
+fold-vs-sequential disagreement shows up bit for bit in the responses
+or the committed arena.
+
+Segments are built adversarially, every class fold_classify must either
+fold exactly or reject to the replay:
+
+  * long hot runs (3 hot slots over a tiny arena);
+  * hstar violations — mixed distinct nonzero hits in one run;
+  * config flips mid-segment (limit / duration / algorithm);
+  * AGG lanes inside multi-lane runs (fold must reject);
+  * leading and interleaved zero-hit reads (the read-leak telescoping
+    edge on leaky buckets);
+  * recycle inits mid-run (is_init starts a fresh virtual segment);
+  * arena rows violating the leaky invariant (remaining > limit).
+
+Both lowerings are pinned: the int64 oracle path against the serial
+contract, and the compact32-XLA path against the int64 path on the same
+windows (all values inside the compact caps by construction).
+"""
+
+import numpy as np
+import pytest
+
+import gubernator_tpu  # noqa: F401  (enables x64)
+import jax
+import jax.numpy as jnp
+
+from gubernator_tpu.ops import kernel
+from gubernator_tpu.ops import pallas_kernel as pk
+
+T0 = 1_754_000_000_000
+
+
+def _adversarial_state(rng, C, now):
+    """Arena rows inside the compact caps, with deliberate leaky-invariant
+    violations (remaining > limit) and times straddling now."""
+    limit = rng.integers(1, 900, C).astype(np.int64)
+    remaining = rng.integers(0, 1000, C).astype(np.int64)  # may exceed limit
+    return kernel.BucketState(
+        limit=jnp.asarray(limit),
+        duration=jnp.asarray(rng.integers(1, 500_000, C), jnp.int64),
+        remaining=jnp.asarray(remaining),
+        tstamp=jnp.asarray(now + rng.integers(-400_000, 400_000, C)),
+        expire=jnp.asarray(now + rng.integers(-400_000, 400_000, C)),
+        algo=jnp.asarray(rng.integers(0, 2, C), jnp.int32),
+    )
+
+
+def _adversarial_batch(rng, B, C):
+    slot = rng.integers(0, C, B).astype(np.int32)
+    hot = rng.integers(0, C, 3)
+    dup = rng.random(B) < 0.7
+    slot[dup] = hot[rng.integers(0, 3, int(dup.sum()))]
+    slot[rng.random(B) < 0.1] = kernel.PAD_SLOT
+
+    hstar = int(rng.integers(1, 4))
+    hits = np.where(rng.random(B) < 0.5, hstar, 0).astype(np.int64)
+    mix = rng.random(B) < 0.25  # distinct nonzero hits: hstar violations
+    hits[mix] = rng.integers(1, 9, int(mix.sum()))
+
+    limit = np.full(B, int(rng.integers(2, 12)), np.int64)
+    flip = rng.random(B) < 0.2  # config flips mid-segment
+    limit[flip] = rng.integers(2, 900, int(flip.sum()))
+    duration = np.full(B, int(rng.integers(1_000, 90_000)), np.int64)
+    dflip = rng.random(B) < 0.2
+    duration[dflip] = rng.integers(1_000, 500_000, int(dflip.sum()))
+    algo = np.full(B, int(rng.integers(0, 2)), np.int32)
+    aflip = rng.random(B) < 0.15
+    algo[aflip] = rng.integers(0, 2, int(aflip.sum())).astype(np.int32)
+
+    is_init = (rng.random(B) < 0.1) & (slot >= 0)
+    agg = (rng.random(B) < 0.15) & (slot >= 0) & (hits > 0)
+    eslot = np.where(agg, slot | kernel.AGG_SLOT_BIT, slot).astype(np.int32)
+    return kernel.WindowBatch(slot=eslot, hits=hits, limit=limit,
+                              duration=duration, algo=algo, is_init=is_init)
+
+
+def _serial_oracle(step1, st, batch, now):
+    """The sequential contract: one lane per dispatch, in lane order."""
+    outs = []
+    for i in range(batch.slot.shape[0]):
+        one = kernel.WindowBatch(*[np.asarray(a)[i:i + 1] for a in batch])
+        st, out = step1(st, one, now)
+        outs.append(out)
+    cat = lambda f: np.concatenate(  # noqa: E731
+        [np.asarray(getattr(o, f)) for o in outs])
+    return st, kernel.WindowOutput(*[cat(f)
+                                     for f in kernel.WindowOutput._fields])
+
+
+@pytest.mark.parametrize("seed", list(range(12)))
+def test_fold_adversarial_segments_match_serial(seed):
+    B, C = 32, 24
+    rng = np.random.default_rng(7000 + seed)
+    now = T0
+    st_batch = _adversarial_state(rng, C, now)
+    st_c32 = kernel.BucketState(*[jnp.asarray(np.asarray(a))
+                                  for a in st_batch])
+    st_serial = kernel.BucketState(*[jnp.asarray(np.asarray(a))
+                                     for a in st_batch])
+    step = jax.jit(kernel.window_step)
+    step_c32 = jax.jit(pk.window_step_compact32_xla)
+    for w in range(4):
+        now += int(rng.integers(1, 300_000))  # cross expiry boundaries
+        batch = _adversarial_batch(rng, B, C)
+        nj = jnp.int64(now)
+
+        # PAD lanes carry unspecified outputs (the engine masks them on
+        # slot >= 0 before any response leaves the device) — compare
+        # occupied lanes only; the committed arena must agree everywhere
+        valid = np.asarray(batch.slot) >= 0
+
+        st_batch, out = step(st_batch, batch, nj)
+        st_serial, want = _serial_oracle(step, st_serial, batch, nj)
+        for f in kernel.WindowOutput._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(out, f))[valid],
+                np.asarray(getattr(want, f))[valid],
+                err_msg=f"seed {seed} window {w} out.{f}")
+        for name, a, b in zip(kernel.BucketState._fields,
+                              st_batch, st_serial):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"seed {seed} window {w} state.{name}")
+
+        st_c32, out32 = step_c32(st_c32, batch, nj)
+        for f in kernel.WindowOutput._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(out32, f))[valid],
+                np.asarray(getattr(out, f))[valid],
+                err_msg=f"seed {seed} window {w} compact32 out.{f}")
+        for name, a, b in zip(kernel.BucketState._fields, st_c32, st_batch):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"seed {seed} window {w} compact32 state.{name}")

@@ -322,6 +322,50 @@ def test_mesh_fused_census_vs_legacy_step():
         f"below the legacy step census {cl} per window")
 
 
+def test_composed_window_census_budget():
+    """Kernel-ladder gate: the fully-composed serving window (fused drain
+    + GLOBAL sub-window + analytics reduction, one executable, K=8 stack)
+    must trace to >=3x fewer executed kernels per window than the
+    pre-ladder anchor — 1257 drain + 283 analytics kernels over a K=8
+    stack = 192.5/window, measured at the head this PR branched from,
+    when analytics was a second dispatch and GLOBAL paid a read+apply
+    pair per window.  The census is box-independent (a property of the
+    traced program), so the anchor is a pinned constant, not a stash.
+    Secondary bar: the composed XLA lowering (the arm CPU smoke serves)
+    must not creep past its measured ceiling either."""
+    from gubernator_tpu.config import AnalyticsConfig
+
+    ANCHOR_KPW = 192.5   # (1257 + 283) / 8: pre-ladder composed window
+    XLA_CEILING = 1550   # composed+analytics XLA arm measured 1473
+
+    eng = _mk_engine()
+    conf = AnalyticsConfig()
+    eng.enable_analytics(conf)
+    geom = (conf.sketch_depth, conf.sketch_width, conf.tenant_slots,
+            conf.topk, conf.over_weight)
+    KC = 8
+    packed = np.zeros((KC, S, B, 2), np.int64)
+    nows = np.full(KC, T0, np.int64)
+    gb, ga, upd = eng.empty_drain_control()
+    ten = np.zeros((KC, S, B), np.int32)
+    args = (eng.state, eng.gstate, eng.gcfg, packed, gb, ga, upd, nows,
+            eng._an_sketch, ten, jnp.int64(0))
+
+    fused = engine_mod._compiled_pipeline_step_global_impl(
+        eng.mesh, False, True, True, geom)
+    cf = pk.kernel_census(jax.make_jaxpr(fused)(*args))
+    assert cf * 3 <= ANCHOR_KPW * KC, (
+        f"composed window census {cf} over {KC} windows = {cf / KC:.1f} "
+        f"kernels/window, not >=3x below the {ANCHOR_KPW}/window anchor")
+
+    xla = engine_mod._compiled_pipeline_step_global_impl(
+        eng.mesh, False, True, False, geom)
+    cx = pk.kernel_census(jax.make_jaxpr(xla)(*args))
+    assert cx <= XLA_CEILING, (
+        f"composed XLA arm census {cx} crept past the {XLA_CEILING} "
+        f"ceiling (measured 1473 at this PR)")
+
+
 # ---------------------------------------------------------------------------
 # end to end: the lockstep batcher serving through the fused drain
 

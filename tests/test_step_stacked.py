@@ -15,7 +15,7 @@ from gubernator_tpu.core.engine import RateLimitEngine
 T0 = 1_700_000_000_000
 
 
-def make_engine(use_native):
+def make_engine(use_native, **kw):
     return RateLimitEngine(
         capacity_per_shard=64,
         batch_per_shard=16,
@@ -23,6 +23,7 @@ def make_engine(use_native):
         global_batch_per_shard=8,
         max_global_updates=8,
         use_native=use_native,
+        **kw,
     )
 
 
@@ -204,3 +205,47 @@ def test_global_stack_keeps_composed_variant(monkeypatch):
     eng.step_stacked([reqs, reqs], now=T0)
     assert False not in picked, (
         "stack with live GLOBAL lanes routed to the skip variant")
+
+
+def test_skip_global_static_twin(monkeypatch):
+    """skip_global=True is a config-level promise of zero GLOBAL traffic:
+    every stacked dispatch lowers to the GLOBAL-skipping twin without
+    inspecting the stack.  The choice derives from config alone, so every
+    mesh process makes it identically — mesh-legal where the per-stack
+    inertness gate is not.  Results stay bit-identical to sequential
+    step(), and a live GLOBAL lane under the promise raises loudly."""
+    from gubernator_tpu.core import engine as eng_mod
+
+    rng = np.random.default_rng(11)
+    wins = [[RateLimitReq(name="sgc", unique_key=f"k{rng.integers(0, 20)}",
+                          hits=int(rng.integers(0, 3)), limit=10,
+                          duration=60_000,
+                          algorithm=int(rng.integers(0, 2)))
+             for _ in range(16)] for _ in range(3)]
+    ref = make_engine(False)
+    want = [ref.step(w, now=T0) for w in wins]
+
+    # construct BEFORE installing the spy: __init__ caches the composed
+    # default; every fetch observed below is a step_windows routing choice
+    eng = make_engine(False, skip_global=True)
+
+    picked = []
+    real = eng_mod._compiled_multi_step
+
+    def spy(mesh, with_global=True):
+        picked.append(with_global)
+        return real(mesh, with_global=with_global)
+
+    monkeypatch.setattr(eng_mod, "_compiled_multi_step", spy)
+
+    got = eng.step_stacked(wins, now=T0)
+    assert picked and True not in picked, picked
+    for k, (gw, ww) in enumerate(zip(got, want)):
+        for j, (g, r) in enumerate(zip(gw, ww)):
+            assert (g.status, g.limit, g.remaining, g.reset_time) == \
+                (r.status, r.limit, r.remaining, r.reset_time), (k, j)
+
+    greq = [RateLimitReq(name="sgv", unique_key="h", hits=1, limit=20,
+                         duration=60_000, behavior=Behavior.GLOBAL)]
+    with pytest.raises(ValueError, match="skip_global"):
+        eng.step_stacked([greq], now=T0 + 1)
