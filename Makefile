@@ -1,7 +1,7 @@
 # Dev targets (the reference Makefile:1-15 has only release/docker; we add
 # the working set).
 
-.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos test-obs test-chaos test-analytics test-overlap test-chain test-frontdoor test-tiers proto bench bench-smoke docker lint cluster
+.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos test-obs test-chaos test-analytics test-overlap test-chain test-frontdoor test-tiers test-devprof proto bench bench-smoke docker lint cluster
 
 test:
 	python -m pytest tests/ -x -q
@@ -83,6 +83,15 @@ test-frontdoor:
 test-tiers:
 	python -m pytest tests/ -x -q -m "tiers and not slow"
 
+# the device-time flight-recorder slice: jax.profiler trace parsing +
+# kernel attribution (every census arm gets nonzero measured ms/window
+# from a REAL parsed trace), window-clock EWMA + slow-window exemplars,
+# shm traceparent region roundtrip, the /v1/admin/kernels plane, and
+# malformed-trace degradation.  Part of tier-1 (`test-core` picks it up
+# too); this target runs just the slice.
+test-devprof:
+	python -m pytest tests/ -x -q -m "devprof and not slow"
+
 proto:
 	cd gubernator_tpu/api/proto && protoc --python_out=. gubernator.proto peers.proto
 
@@ -99,10 +108,13 @@ bench:
 # simulated tunnel RTT) and prints the device-tier vs serving-drain
 # reconciliation (kernel census + per-dispatch wall), and the tier probe
 # sweeps arena fraction under Zipf traffic (warm hit rate, promotions/s,
-# window p99, tiers-on vs tiers-off).
+# window p99, tiers-on vs tiers-off).  The trace-overhead probe closes
+# the loop: it asserts the continuous device profiler (GUBER_DEVPROF=
+# periodic) costs <2% of the untraced serving rate.
 bench-smoke:
 	python scripts/bench_compare.py
 	GUBER_PROBE_PLATFORM=cpu python scripts/probe_census.py
+	GUBER_PROBE_PLATFORM=cpu python scripts/probe_trace_overhead.py
 	GUBER_PROBE_PLATFORM=cpu python scripts/probe_overlap.py
 	GUBER_PROBE_PLATFORM=cpu GUBER_PROBE_FD_WORKERS=0,2 GUBER_PROBE_SECONDS=2 python scripts/probe_frontdoor.py
 	GUBER_PROBE_PLATFORM=cpu GUBER_PROBE_B=1024 GUBER_PROBE_C=4096 GUBER_PROBE_SECONDS=1 python scripts/probe_chain.py

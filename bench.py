@@ -884,7 +884,7 @@ def bench_census(result):
     out = os.environ[OUT_ENV] + ".census.json"
     try:
         env = dict(os.environ, GUBER_PROBE_PLATFORM="cpu",
-                   GUBER_PROBE_JSON=out)
+                   GUBER_PROBE_JSON=out, GUBER_PROBE_MEASURE="1")
         proc = subprocess.run([sys.executable, probe], timeout=240,
                               capture_output=True, env=env)
         if proc.returncode != 0:
@@ -900,6 +900,14 @@ def bench_census(result):
             result["kernels_per_window"] = head["kernels_per_window"]
             result["projected_chip_decisions_per_sec"] = \
                 head["projected_chip_decisions_per_sec"]
+        # measured device-time side of the reconciliation (devprof):
+        # per-arm ms/window from a real jax.profiler capture plus the
+        # folded kernel table — box-DEPENDENT, so bench_compare gates it
+        # against the same-host stash only
+        if "measured_ms_per_window" in data:
+            result["measured_ms_per_window"] = data["measured_ms_per_window"]
+        if "measured_kernel_table" in data:
+            result["measured_kernel_table"] = data["measured_kernel_table"]
         log(f"# census: {result.get('census_kernels_per_window')} "
             f"kernels/window; projected "
             f"{result.get('projected_chip_decisions_per_sec', 0):,} "

@@ -15,6 +15,7 @@ The snapshot/restore subcommands drive the daemon's HTTP admin plane
   python -m gubernator_tpu.cmd.cli debug    <http-addr>      # introspection
   python -m gubernator_tpu.cmd.cli top      <http-addr> [--watch N]
   python -m gubernator_tpu.cmd.cli slo      <http-addr> [--watch N]
+  python -m gubernator_tpu.cmd.cli kernels  <http-addr> [--measure]
 
 `debug` pretty-prints the daemon's /v1/admin/debug snapshot (arena
 occupancy, admission queue, breaker states, congestion window, per-stage
@@ -345,11 +346,65 @@ def cmd_slo(args) -> int:
     return _watch_loop(once, args.watch)
 
 
+def cmd_kernels(args) -> int:
+    """Census count × measured ms/window reconciliation table from
+    /v1/admin/kernels (observability/devprof.py)."""
+    def once() -> int:
+        url = (f"{_http_base(args.address)}/v1/admin/kernels"
+               f"?census={0 if args.no_census else 1}")
+        if args.measure:
+            url += f"&measure=1&iters={args.iters}"
+        try:
+            with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+                snap = json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            print(f"kernels fetch failed: "
+                  f"{e.read().decode('utf-8', 'replace')}", file=sys.stderr)
+            return 1
+        except Exception as e:
+            print(f"kernels fetch failed: {e}", file=sys.stderr)
+            return 1
+        arms = snap.get("arms", {})
+        print(f"{'arm':<22}{'census k/win':>14}{'measured ms/win':>18}")
+        for arm, row in sorted(arms.items()):
+            cen = row.get("census_kernels_per_window")
+            ms = row.get("measured_ms_per_window")
+            print(f"{arm:<22}"
+                  f"{cen if cen is not None else '-':>14}"
+                  f"{f'{ms:.4f}' if ms is not None else '-':>18}")
+        clock = snap.get("clock")
+        if clock:
+            print("window clock:")
+            for arm, c in sorted(clock.get("arms", {}).items()):
+                print(f"  {arm}: ewma={c['ewma_ms']:.3f}ms "
+                      f"count={c['count']}")
+            for s in clock.get("slow_windows", []):
+                ids = ",".join(s.get("trace_ids", [])) or "-"
+                print(f"  slow {s['arm']}: {s['ms']}ms traces={ids}")
+        rows = snap.get("table", [])
+        if rows:
+            print(f"{'kernel':<44}{'arm':<22}{'count':>8}{'ms/win':>10}")
+            for r in rows[:args.n]:
+                print(f"{r['kernel'][:43]:<44}{r['arm']:<22}"
+                      f"{r['count']:>8}{r['ms_per_window']:>10.4f}")
+        else:
+            print("(kernel table empty — arm a capture, run `cli kernels "
+                  "--measure`, or set GUBER_DEVPROF=periodic)")
+        ctrl = snap.get("controller")
+        if ctrl:
+            print(f"continuous: interval={ctrl['interval_s']}s "
+                  f"drains={ctrl['drains']} cycles={ctrl['cycles']} "
+                  f"sheds={ctrl['sheds']} rows={ctrl['kernel_rows']}")
+        return 0
+
+    return _watch_loop(once, args.watch)
+
+
 def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     # compatibility: a bare address (or nothing) runs load generation
     if not argv or argv[0] not in ("load", "snapshot", "restore", "debug",
-                                   "top", "slo"):
+                                   "top", "slo", "kernels"):
         argv.insert(0, "load")
 
     p = argparse.ArgumentParser("gubernator-tpu-cli")
@@ -400,6 +455,22 @@ def main(argv=None) -> None:
                     help="refresh every SECONDS until ^C (0 = one shot)")
     po.add_argument("--timeout", type=float, default=5.0)
 
+    pk = sub.add_parser("kernels", help="census × measured device-time "
+                        "kernel table (devprof)")
+    pk.add_argument("address", help="daemon HTTP address (host:port)")
+    pk.add_argument("-n", type=int, default=20,
+                    help="kernel-table rows to show")
+    pk.add_argument("--measure", action="store_true",
+                    help="run the arm-scoped measured probe inline "
+                    "(seconds of compile on a cold daemon)")
+    pk.add_argument("--iters", type=int, default=2,
+                    help="measured-probe iterations per arm")
+    pk.add_argument("--no-census", action="store_true",
+                    help="skip the census column (faster on a cold daemon)")
+    pk.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                    help="refresh every SECONDS until ^C (0 = one shot)")
+    pk.add_argument("--timeout", type=float, default=300.0)
+
     args = p.parse_args(argv)
     if args.cmd == "snapshot":
         sys.exit(cmd_snapshot(args))
@@ -411,6 +482,8 @@ def main(argv=None) -> None:
         sys.exit(cmd_top(args))
     if args.cmd == "slo":
         sys.exit(cmd_slo(args))
+    if args.cmd == "kernels":
+        sys.exit(cmd_kernels(args))
     try:
         asyncio.run(_load(args.address, args.count, args.concurrency,
                           http_address=args.http_address))

@@ -368,6 +368,22 @@ class Config:
         default_factory=lambda: env_float("GUBER_TRACE_SAMPLE", 0.0))
     trace_export: str = field(
         default_factory=lambda: _env("GUBER_TRACE_EXPORT"))
+    # Device-time flight recorder (observability/devprof.py).  Mode "" =
+    # off (window clocks still run when metrics are wired; the kernel
+    # table only fills from explicit captures); "periodic" re-arms
+    # N-drain jax.profiler captures on a shedding background thread and
+    # folds the parsed kernel table between intervals.
+    devprof_mode: str = field(
+        default_factory=lambda: _env("GUBER_DEVPROF"))
+    devprof_interval_s: float = field(
+        default_factory=lambda: env_float("GUBER_DEVPROF_INTERVAL_S", 30.0,
+                                          minimum=0.05))
+    devprof_drains: int = field(
+        default_factory=lambda: env_int("GUBER_DEVPROF_DRAINS", 8))
+    devprof_ring: int = field(
+        default_factory=lambda: env_int("GUBER_DEVPROF_RING", 64))
+    devprof_slow_ms: float = field(
+        default_factory=lambda: env_float("GUBER_DEVPROF_SLOW_MS", 50.0))
 
 
 @dataclass

@@ -163,15 +163,18 @@ class WindowBatcher:
         return await self.pipeline.submit_rpc(data, peer_mode=peer_mode)
 
     async def submit_cols(self, cols: tuple, n: int,
-                          want_cols: bool = False):
+                          want_cols: bool = False, ctx=None):
         """Frontdoor shm lane: serve worker-parsed request COLUMNS through
         the pipeline (core/pipeline.py ColsJob); with want_cols the result
         is decision columns for a worker-encoded completion instead of
-        engine-encoded bytes.  None => the hub runs the engine-side
-        Python fallback."""
+        engine-encoded bytes.  `ctx` carries the worker-propagated
+        traceparent (shm trace region) so drain spans root under the
+        caller's trace.  None => the hub runs the engine-side Python
+        fallback."""
         if self.pipeline is None:
             return None
-        return await self.pipeline.submit_cols(cols, n, want_cols=want_cols)
+        return await self.pipeline.submit_cols(cols, n, want_cols=want_cols,
+                                               ctx=ctx)
 
     def start_lockstep(self) -> None:
         """Begin the lockstep tick loop (mesh mode; call inside the loop)."""

@@ -1356,10 +1356,15 @@ class RateLimitEngine:
         blocking device_get per plane (words, then mismatch flag, then
         stats) — each is a separate host sync point on the transfer stream;
         batching them into one call lets the runtime coalesce the copies
-        (core/pipeline.py `_complete_sync`)."""
-        if not self.multiprocess:
-            return jax.device_get(list(arrs))
-        return [self._fetch_local_stacked(a) for a in arrs]
+        (core/pipeline.py `_complete_sync`).
+
+        The guber_fetch annotation is a devprof classification anchor
+        (observability/devprof.py): kernels inside it are the D2H copy
+        cost, not drain-body time."""
+        with jax.profiler.TraceAnnotation("guber_fetch"):
+            if not self.multiprocess:
+                return jax.device_get(list(arrs))
+            return [self._fetch_local_stacked(a) for a in arrs]
 
     def _lane_bucket(self, max_fill: int) -> int:
         """Occupied-prefix lane width: the smallest compiled lane-bucket
@@ -1643,8 +1648,12 @@ class RateLimitEngine:
         fn = _compiled_analytics_reduce(self.mesh, conf.sketch_depth,
                                         conf.sketch_width, conf.tenant_slots,
                                         conf.topk, conf.over_weight)
-        self._an_sketch, stats = fn(self._an_sketch, self.state.expire,
-                                    packed, words, tenants, now_in, decay_in)
+        # guber_analytics: devprof classification anchor — the standalone
+        # reduction's kernels attribute to the analytics arm, not the drain
+        with jax.profiler.TraceAnnotation("guber_analytics"):
+            self._an_sketch, stats = fn(self._an_sketch, self.state.expire,
+                                        packed, words, tenants, now_in,
+                                        decay_in)
         return stats
 
     def process(

@@ -427,7 +427,8 @@ class _DrainResult:
                  "leftover", "now", "n_decisions", "n_lanes", "k_used",
                  "error", "started", "ring_peers",
                  "pack_done", "dispatch_done", "fetch_start", "fetch_done",
-                 "oldest_enq", "arena", "cols_owner", "cfut", "deferred")
+                 "oldest_enq", "arena", "cols_owner", "cfut", "deferred",
+                 "arm", "chain_fetch_start", "chain_fetch_done")
 
     def __init__(self):
         self.words = None
@@ -468,6 +469,15 @@ class _DrainResult:
         self.fetch_start = 0.0
         self.fetch_done = 0.0
         self.oldest_enq = 0.0
+        # devprof attribution: which executable family served this drain
+        # (composed_analytics / composed_drain / fused_window /
+        # compact32_xla — the same arm names scripts/probe_census.py
+        # counts), and the shared stacked-fetch window when the drain
+        # committed through a deferred-fetch chain (satellite span +
+        # chain_fetch stage; 0.0 = not chained)
+        self.arm = ""
+        self.chain_fetch_start = 0.0
+        self.chain_fetch_done = 0.0
 
 
 class DispatchPipeline:
@@ -551,6 +561,14 @@ class DispatchPipeline:
         # change that races an in-flight RPC falls back instead of deciding
         # keys this node does not own.
         self.rpc_enabled = self.enabled and not self.lockstep
+        # always-on per-executable window clock (observability/devprof.py):
+        # dispatch→fetch-ready wall time per drain, labelled by the arm the
+        # census probe counts.  None (no metrics) keeps the commit path at
+        # one attribute check.
+        self.devclock = None
+        if metrics is not None:
+            from gubernator_tpu.observability.devprof import WindowClock
+            self.devclock = WindowClock(metrics=metrics)
         # set by the batcher: async callable (reqs, accumulate) -> resps,
         # used when a list job needs the full path (legacy lane)
         self.legacy: Optional[Callable] = None
@@ -742,7 +760,8 @@ class DispatchPipeline:
         return await fut
 
     async def submit_cols(self, cols: tuple, n: int,
-                          want_cols: bool = False) -> Optional[bytes]:
+                          want_cols: bool = False,
+                          ctx=None) -> Optional[bytes]:
         """Serve worker-parsed GetRateLimitsReq COLUMNS (the frontdoor shm
         lane): (key_bytes, key_ends, hits, limits, durations, algos) views
         into the worker's slab pack-stack directly — parsed once, in the
@@ -763,6 +782,14 @@ class DispatchPipeline:
         fut = self._loop.create_future()
         job = ColsJob(cols, n, fut, want_cols=want_cols)
         job.enq = time.monotonic()
+        if ctx is not None:
+            # frontdoor-propagated traceparent (shm trace region): root the
+            # engine's drain spans under the caller's trace exactly like
+            # submit_rpc does for in-process contexts
+            job.ctxs = [ctx]
+            if self.tracer is not None:
+                ctx.enqueued_at = job.enq
+                self.tracer.record_span(ctx, "enqueue", job.enq, job.enq)
         self._jobs.append(job)
         self._pump()
         return await fut
@@ -1065,9 +1092,18 @@ class DispatchPipeline:
             if res.words is not None:
                 arrs.extend((res.words, res.mism))
         fetched = iter(eng.fetch_stacked_many(arrs) if arrs else ())
+        t_fetched = time.monotonic()
         pairs = []
         for res in group:
-            res.fetch_start = t0
+            # stage accounting: the SHARED stacked fetch is its own
+            # (chain_fetch) window — charging its full wall time to every
+            # member's drain_commit would over-count it stride× in the
+            # stage sums (tests/test_tracing.py asserts the accounting at
+            # stride 4).  Each member's drain_commit covers only its own
+            # demux.
+            res.chain_fetch_start = t0
+            res.chain_fetch_done = t_fetched
+            res.fetch_start = time.monotonic()
             if res.words is None:  # all-forwarded member: nothing local
                 wflat = np.empty((0, B), np.int64)
                 clflat = None
@@ -1106,6 +1142,16 @@ class DispatchPipeline:
             for res in group:
                 self._fail_completed(res, e)
             return
+        if self.metrics is not None and pairs:
+            # ONE shared-fetch observation per group (not per member):
+            # stage_snapshot appends non-canonical stages after STAGES, so
+            # chain_fetch shows up in /v1/admin/debug without widening the
+            # canonical per-request stage set
+            head = pairs[0][0]
+            if head.chain_fetch_done > head.chain_fetch_start:
+                self.metrics.observe_stage(
+                    "chain_fetch",
+                    head.chain_fetch_done - head.chain_fetch_start)
         for res, outs in pairs:
             self._commit_completed(res, outs)
 
@@ -1435,6 +1481,25 @@ class DispatchPipeline:
             if res.fetch_done and res.fetch_start:
                 m.observe_stage("drain_commit",
                                 res.fetch_done - res.fetch_start)
+        # window clock (observability/devprof.py): dispatch→fetch-ready
+        # per executable arm, EWMA + histogram; slow windows capture
+        # trace-ID exemplars lazily (the thunk only runs on a slow window)
+        dc = self.devclock
+        if (dc is not None and res.arm and res.dispatch_done
+                and res.fetch_done):
+            staged = res.staged
+            def _trace_ids(_jobs=staged):
+                ids = []
+                for job in _jobs:
+                    c = getattr(job, "ctx", None)
+                    if c is not None:
+                        ids.append(c.trace_id)
+                    for c in (getattr(job, "ctxs", None) or ()):
+                        if c is not None:
+                            ids.append(c.trace_id)
+                return ids[:4]
+            dc.observe(res.arm, res.fetch_done - res.dispatch_done,
+                       trace_ids=_trace_ids, windows=max(1, res.k_used))
         tr = self.tracer
         if tr is not None and tr.enabled:
             ctxs = set()
@@ -1458,6 +1523,12 @@ class DispatchPipeline:
                 if res.fetch_done and res.fetch_start:
                     tr.record_span(c, "drain_commit", res.fetch_start,
                                    res.fetch_done)
+                if res.chain_fetch_done > res.chain_fetch_start:
+                    # the SHARED stacked fetch window (deferred-fetch
+                    # chain): one span per request context so stage sums
+                    # reconcile with e2e at stride > 1
+                    tr.record_span(c, "chain_fetch", res.chain_fetch_start,
+                                   res.chain_fetch_done)
         self._pump(force=True)
 
     async def _assemble_mixed(self, job: RpcJob, local_parts, now) -> None:
@@ -1714,6 +1785,10 @@ class DispatchPipeline:
             # to a differently-shaped dispatch.
             an_args = (self._analytics_stage(res, packed, K, now)
                        if self.analytics is not None else None)
+            # devprof arm: which census executable family this dispatch
+            # lowers to (scripts/probe_census.py's arm names)
+            res.arm = ("composed_analytics" if an_args is not None
+                       else "composed_drain")
             before = eng.windows_processed
             dispatched = False
             try:
@@ -1778,6 +1853,8 @@ class DispatchPipeline:
                 res.stats = stats
                 res.an_decay = an_args[1]
         elif k_used:  # an all-forwarded drain has nothing to dispatch
+            res.arm = ("fused_window" if self.fused_serving
+                       else "compact32_xla")
             kb = next(b for b in self._k_buckets if b >= k_used)
             try:
                 # fault seam: an injected dispatch failure aborts the C

@@ -137,6 +137,23 @@ class Instance:
                                      self.metrics, lockstep_clock=clock,
                                      qos=self.qos, tracer=self.tracer,
                                      analytics=self.analytics, slo=self.slo)
+        # Device-time flight recorder (observability/devprof.py): the
+        # kernel table + optional continuous-capture controller, sharing
+        # the batcher's armable ProfileCapture.  The pipeline's per-drain
+        # window clock (devclock) is folded into the same facade so
+        # /v1/admin/kernels and `cli kernels` read one snapshot.
+        from gubernator_tpu.observability.devprof import Devprof
+        eng = self.engine
+        self.devprof = Devprof(
+            mode=getattr(self.conf, "devprof_mode", ""),
+            metrics=self.metrics,
+            profile=self.batcher.profile,
+            windows_fn=lambda: int(eng.windows_processed),
+            interval=getattr(self.conf, "devprof_interval_s", None),
+            drains=getattr(self.conf, "devprof_drains", None))
+        if self.batcher.pipeline is not None:
+            self.devprof.clock = self.batcher.pipeline.devclock
+        self.devprof.start()
         self.global_mgr = GlobalManager(
             self.conf.behaviors, self, self.metrics, log,
             health=self.conf.health)
@@ -743,4 +760,5 @@ class Instance:
 
     def close(self) -> None:
         self.global_mgr.stop()
+        self.devprof.close()
         self.batcher.close()

@@ -99,7 +99,7 @@ class ShmRecord:
     records carry a bytes copy of the payload."""
 
     __slots__ = ("slot", "kind", "req_id", "deadline", "n", "cols",
-                 "name_lens", "payload", "counts")
+                 "name_lens", "payload", "counts", "trace")
 
     def __init__(self, slot: int, kind: int, req_id: int, deadline: float):
         self.slot = slot
@@ -111,6 +111,7 @@ class ShmRecord:
         self.name_lens = None
         self.payload = b""
         self.counts = None  # KIND_BATCH_COLS: per-RPC item counts
+        self.trace = None   # propagated traceparent (hi64, lo64, span) or None
 
 
 try:  # pragma: no cover - stdlib-version dependent
@@ -190,7 +191,14 @@ class WorkerChannel:
         # offsets (status/limit/remaining/reset over ke/hi/li/du, flags
         # over algos) — by completion time the request columns are dead.
         self._cnt_off = _REC_HDR
-        cols0 = _REC_HDR + 8 * MAX_BATCH_RPCS
+        # trace region: uint64[4] = [trace_id_hi, trace_id_lo, span_id,
+        # flags (bit0 = valid)] — the worker-propagated W3C traceparent of
+        # a COLS/BATCH record, so the engine can root its drain spans
+        # under the caller's trace (the front-door blackout fix).  Sits
+        # between the counts region and the columns; workers write it (or
+        # clear it) before every commit, since slabs are reused.
+        self._tr_off = _REC_HDR + 8 * MAX_BATCH_RPCS
+        cols0 = self._tr_off + 32
         self.cap_items = min(
             MAX_ITEMS,
             max(0, (slab_bytes - cols0) // (_COLS_BYTES_PER_ITEM + 8)))
@@ -317,6 +325,25 @@ class WorkerChannel:
             np.frombuffer(buf, np.int64, c, base + self._du_off),
             np.frombuffer(buf, np.int32, c, base + self._al_off),
         )
+
+    def _trace_view(self, slot: int) -> np.ndarray:
+        buf = self._shm.buf
+        base = self._pool_off + slot * self.slab_bytes
+        return np.frombuffer(buf, np.uint64, 4, base + self._tr_off)
+
+    def set_trace(self, slot: int, hi: int, lo: int, span: int) -> None:
+        """Stamp the record's propagated traceparent (worker side, before
+        commit): 128-bit trace id as two u64 halves + the caller's span id."""
+        tv = self._trace_view(slot)
+        tv[0] = np.uint64(hi)
+        tv[1] = np.uint64(lo)
+        tv[2] = np.uint64(span)
+        tv[3] = np.uint64(1)
+
+    def clear_trace(self, slot: int) -> None:
+        """Mark the record as carrying no trace (slabs are reused, so a
+        commit without a traceparent must erase the previous tenant's)."""
+        self._trace_view(slot)[3] = np.uint64(0)
 
     def _slab_hdr(self, slot: int) -> np.ndarray:
         buf = self._shm.buf
@@ -453,6 +480,9 @@ class WorkerChannel:
                 if kind == KIND_BATCH_COLS:
                     m = int(hdr[4])
                     rec.counts = [int(x) for x in self.counts_view(slot)[:m]]
+                tv = self._trace_view(slot)
+                if int(tv[3]) & 1:
+                    rec.trace = (int(tv[0]), int(tv[1]), int(tv[2]))
             else:
                 rec.payload = bytes(self._slabs[slot][
                     _REC_HDR:_REC_HDR + int(hdr[2])])
@@ -542,10 +572,11 @@ FLAG_COLS_OK = 1 << 2     # engine accepts KIND_COLS (standalone + compact)
 
 _MSG_CAP = 256
 _W_ROW0 = 16              # per-worker rows start at this int64 index
-_W_STRIDE = 12
+_W_STRIDE = 13
 # per-worker row fields; single writer per FIELD: the engine owns pid /
 # epoch / restarts, the worker owns port / rpcs / sheds / healthchecks /
-# stalls / encodes / enc_fallbacks / batch_rpcs / batch_flushes
+# stalls / encodes / enc_fallbacks / batch_rpcs / batch_flushes /
+# trace_drops
 W_PID = 0
 W_PORT = 1
 W_EPOCH = 2
@@ -558,6 +589,9 @@ W_ENCODES = 8        # responses the worker encoded from decision columns
 W_ENC_FALLBACK = 9   # completions that arrived as engine-encoded bytes
 W_BATCH_RPCS = 10    # RPCs that rode a coalesced KIND_BATCH_COLS record
 W_BATCH_FLUSHES = 11  # multi-RPC batch publishes (single ring entries)
+W_TRACE_DROPS = 12   # traceparents the shm lane could NOT propagate (RAW
+#                      fallback records, non-first members of a coalesced
+#                      batch — one record carries one trace region)
 
 
 class FrontdoorStatus:

@@ -148,12 +148,36 @@ class _Worker:
         self._req_id = 0
         self._waiters: Dict[int, asyncio.Future] = {}
         self._batches: Dict[int, tuple] = {}  # rid -> (futs, counts)
-        self._pending: List[tuple] = []       # (data, fut, deadline)
+        self._pending: List[tuple] = []       # (data, fut, deadline, tp)
         self._ebuf: Optional[np.ndarray] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
 
     def _bump(self, field: int, n: int = 1) -> None:
         self.status.bump_w(self.worker_id, field, n)
+
+    def traceparent(self, context) -> Optional[tuple]:
+        """The RPC's sampled W3C traceparent as shm trace-region ints
+        (trace_id_hi, trace_id_lo, span_id), or None when absent,
+        malformed, or unsampled.  Parsed once HERE, in the worker — the
+        engine only ever sees the three fixed-width words (the front-door
+        trace blackout fix)."""
+        md = getattr(context, "invocation_metadata", None)
+        if not callable(md):
+            return None
+        raw = None
+        for k, v in (md() or ()):
+            if k == "traceparent":
+                raw = v if isinstance(v, str) else \
+                    bytes(v).decode("ascii", "replace")
+                break
+        if not raw:
+            return None
+        from gubernator_tpu.observability.tracing import parse_traceparent
+        ctx = parse_traceparent(raw)
+        if ctx is None:
+            return None
+        return (int(ctx.trace_id[:16], 16), int(ctx.trace_id[16:], 16),
+                int(ctx.span_id, 16))
 
     # -------------------------------------------------------- response encode
 
@@ -283,17 +307,18 @@ class _Worker:
             return
         slot = self.chan.alloc()
         if slot is None:  # handlers shed ring_full on their own alloc
-            for _, fut, _ in pending:
+            for _, fut, _, _ in pending:
                 if not fut.done():
                     fut.set_result(None)
             return
         kb, ke, hi, li, du, al, nl = self.chan.cols_views(slot)
         counts: List[int] = []
         futs: List[asyncio.Future] = []
+        tps: List[Optional[tuple]] = []
         singles: List[asyncio.Future] = []
         base, koff = 0, 0
         dmin = 0.0
-        for data, fut, deadline in pending:
+        for data, fut, deadline, tp in pending:
             n = -1
             if base < self.chan.cap_items and len(counts) < MAX_BATCH_RPCS:
                 n = self.native.frontdoor_parse_req(
@@ -309,17 +334,33 @@ class _Worker:
             base += n
             counts.append(n)
             futs.append(fut)
+            tps.append(tp)
             if deadline and (dmin == 0.0 or deadline < dmin):
                 dmin = deadline
+        # ONE trace region per record: the first traced member's context
+        # rides the slab; every other traced member is an honest drop
+        # (guber_tpu_frontdoor_trace_drops_total)
+        carried = next((t for t in tps if t is not None), None)
+        extra = sum(1 for t in tps if t is not None) - (1 if carried else 0)
+        if extra > 0:
+            self._bump(shm_ring.W_TRACE_DROPS, extra)
         if not counts:
             self.chan.unalloc(slot)
         elif len(counts) == 1:  # degenerate: a plain COLS record
             rid = self.next_id()
+            if carried is not None:
+                self.chan.set_trace(slot, *carried)
+            else:
+                self.chan.clear_trace(slot)
             self.chan.commit_cols(slot, rid, counts[0], koff, dmin)
             self._waiters[rid] = futs[0]
             self.chan.submit(slot)
         else:
             rid = self.next_id()
+            if carried is not None:
+                self.chan.set_trace(slot, *carried)
+            else:
+                self.chan.clear_trace(slot)
             self.chan.commit_batch(slot, rid, counts, koff, dmin)
             self._batches[rid] = (futs, counts)
             self._bump(shm_ring.W_BATCH_FLUSHES)
@@ -385,6 +426,7 @@ class _WorkerV1:
             rem = tr()
             if rem is not None:
                 deadline = time.monotonic() + rem
+        tp = w.traceparent(context)
         if use_batch:
             # batched wire reads: park this RPC for the tick's flush —
             # RPCs of any size coalesce into one slab + one publish (the
@@ -392,7 +434,7 @@ class _WorkerV1:
             # big columnar record).  None = the parser rejected it (or
             # the batch filled); rerun the classic single path below.
             fut = w._loop.create_future()
-            w._pending.append((data, fut, deadline))
+            w._pending.append((data, fut, deadline, tp))
             if len(w._pending) == 1:
                 w._loop.call_soon(w.flush_batch)
             elif len(w._pending) >= min(w.batch_reads, MAX_BATCH_RPCS):
@@ -426,8 +468,16 @@ class _WorkerV1:
             n = w.native.frontdoor_parse_req(data, kb, ke, hi, li, du,
                                              al, nl, w.chan.cap_items)
             if n > 0:
+                if tp is not None:
+                    w.chan.set_trace(slot, *tp)
+                else:
+                    w.chan.clear_trace(slot)
                 w.chan.commit_cols(slot, rid, n, int(ke[n - 1]), deadline)
                 return await w.roundtrip(slot, rid, context)
+        if tp is not None:
+            # RAW records carry the original request bytes, not the trace
+            # region — the caller's trace cannot follow this record
+            w._bump(shm_ring.W_TRACE_DROPS)
         if not w.chan.write_raw(slot, KIND_RAW, rid, data, deadline):
             w.chan.unalloc(slot)
             await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
@@ -903,7 +953,8 @@ class FrontdoorHub:
                          and inst.qos.admission.saturated)
         if not qos_saturated:
             out = await inst.batcher.submit_cols(rec.cols, rec.n,
-                                                 want_cols=want_cols)
+                                                 want_cols=want_cols,
+                                                 ctx=self._span_ctx(rec))
             if out is not None:
                 m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start,
                               ok=True)
@@ -912,6 +963,18 @@ class FrontdoorHub:
                 return out
         resps = await self._py_fallback(rec, ctx, m, start)
         return self._finish_resps(resps)
+
+    def _span_ctx(self, rec):
+        """Rebuild the worker-propagated traceparent (shm trace region)
+        as a SpanContext so the pipeline roots its drain spans under the
+        caller's trace; None when the record carried no trace or tracing
+        is off."""
+        tr = getattr(self.instance, "tracer", None)
+        if rec.trace is None or tr is None or not tr.enabled:
+            return None
+        from gubernator_tpu.observability.tracing import SpanContext
+        hi, lo, span = rec.trace
+        return SpanContext(f"{hi:016x}{lo:016x}", f"{span:016x}")
 
     async def _py_fallback(self, rec, ctx: _EngineContext, m, start):
         """Reconstruct the record's requests from its columns and run the
@@ -957,7 +1020,8 @@ class FrontdoorHub:
                          and inst.qos.admission.saturated)
         if not qos_saturated:
             out = await inst.batcher.submit_cols(rec.cols, rec.n,
-                                                 want_cols=True)
+                                                 want_cols=True,
+                                                 ctx=self._span_ctx(rec))
             if out is not None:
                 for _ in rec.counts:
                     m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start,
@@ -1000,7 +1064,7 @@ class FrontdoorHub:
         s = {"workers": self.workers, "restarts": self.restarts,
              "rpcs": 0, "sheds": 0, "healthchecks": 0, "stalls": 0,
              "depth": 0, "inflight": 0, "encodes": 0, "enc_fallbacks": 0,
-             "batch_rpcs": 0, "batch_flushes": 0,
+             "batch_rpcs": 0, "batch_flushes": 0, "trace_drops": 0,
              "engine_encode_fallbacks": self.encode_fallbacks}
         if self.status is None:
             return s
@@ -1015,6 +1079,7 @@ class FrontdoorHub:
             s["batch_rpcs"] += self.status.get_w(i, shm_ring.W_BATCH_RPCS)
             s["batch_flushes"] += self.status.get_w(i,
                                                     shm_ring.W_BATCH_FLUSHES)
+            s["trace_drops"] += self.status.get_w(i, shm_ring.W_TRACE_DROPS)
         for ch in self.chans:
             s["depth"] += ch.sub_depth()
             s["inflight"] += ch.inflight()
@@ -1040,6 +1105,7 @@ class FrontdoorHub:
                 "batch_rpcs": self.status.get_w(i, shm_ring.W_BATCH_RPCS),
                 "batch_flushes": self.status.get_w(i,
                                                    shm_ring.W_BATCH_FLUSHES),
+                "trace_drops": self.status.get_w(i, shm_ring.W_TRACE_DROPS),
                 "ring_depth": self.chans[i].sub_depth() if self.chans else 0,
                 "inflight": self.chans[i].inflight() if self.chans else 0,
             })

@@ -17,6 +17,12 @@ from gubernator_tpu.observability.analytics import (
     SLOEngine,
     TrafficAnalytics,
 )
+from gubernator_tpu.observability.devprof import (
+    Devprof,
+    DevprofController,
+    KernelTable,
+    WindowClock,
+)
 from gubernator_tpu.observability.introspect import (
     ProfileCapture,
     build_debug_snapshot,
@@ -37,7 +43,11 @@ from gubernator_tpu.observability.tracing import (
 
 __all__ = [
     "CONTENT_TYPE_LATEST",
+    "Devprof",
+    "DevprofController",
+    "KernelTable",
     "Metrics",
+    "WindowClock",
     "NOOP_SPAN",
     "ProfileCapture",
     "STAGES",

@@ -92,6 +92,23 @@ class ProfileCapture:
         except Exception:
             log.exception("profile capture failed to stop")
 
+    def cancel(self) -> None:
+        """Disarm an in-flight capture (continuous profiling's recovery
+        path when traffic never completes the armed drain count): stop the
+        device trace if it started, drop any remaining armed drains."""
+        with self._lock:
+            was_active = self._active
+            self._active = False
+            self._remaining = 0
+        if not was_active:
+            return
+        try:
+            import jax
+            jax.profiler.stop_trace()
+            log.info("profile capture cancelled -> %s", self._dir)
+        except Exception:
+            log.exception("profile capture failed to cancel")
+
     def status(self) -> dict:
         with self._lock:
             return {"active": self._active, "remaining": self._remaining,
@@ -202,4 +219,7 @@ def build_debug_snapshot(instance) -> dict:
     profile = getattr(instance.batcher, "profile", None)
     if profile is not None:
         out["profile"] = profile.status()
+    devprof = getattr(instance, "devprof", None)
+    if devprof is not None:
+        out["devprof"] = devprof.status()
     return out

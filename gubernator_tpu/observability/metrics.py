@@ -213,6 +213,33 @@ class Metrics:
             "behind one stacked fetch.",
             registry=self.registry,
         )
+        # device-time flight recorder (observability/devprof.py): the
+        # always-on dispatch->fetch-ready window clock per executable arm
+        # (fused_window / composed_drain / composed_analytics), its EWMA,
+        # and the continuous-mode capture outcomes
+        self.device_window_ms = Histogram(
+            "guber_tpu_device_window_ms",
+            "Dispatch-to-fetch-ready wall time of one drain window, by "
+            "executable arm.",
+            ["arm"],
+            buckets=(0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1000),
+            registry=self.registry,
+        )
+        self.device_window_ewma = Gauge(
+            "guber_tpu_device_window_ewma_ms",
+            "EWMA of the dispatch-to-fetch-ready window time, by "
+            "executable arm.",
+            ["arm"],
+            registry=self.registry,
+        )
+        self.devprof_captures = Counter(
+            "guber_tpu_devprof_captures_total",
+            "Continuous-profiling capture cycles by outcome (folded = "
+            "parsed into the kernel table; shed = skipped, a capture was "
+            "already in flight; empty = trace parsed to nothing).",
+            ["status"],  # folded | shed | empty
+            registry=self.registry,
+        )
         # state lifecycle (state/snapshot.py, state/migrate.py): the slot
         # occupancy gauges come from engine.cache_stats at scrape time
         self.cache_slots = Gauge(
@@ -483,6 +510,19 @@ class Metrics:
             ["worker"],
             registry=self.registry,
         )
+        # trace propagation across the shm hand-off (frontdoor.py): RPCs
+        # that arrived with a sampled traceparent the worker could NOT
+        # carry through the slab record (raw-bytes fallback records have
+        # no trace region; a coalesced batch carries only its first
+        # member's context)
+        self.frontdoor_trace_drops = Counter(
+            "guber_tpu_frontdoor_trace_drops_total",
+            "Sampled trace contexts dropped at the shm hand-off, per "
+            "worker (raw-record fallback, or non-first members of a "
+            "coalesced batch).",
+            ["worker"],
+            registry=self.registry,
+        )
         # cluster scale-out surface (core/service.py): ring membership and
         # the cross-node forwarding tax the load harness
         # (scripts/load_cluster.py) reads to report peer overhead
@@ -630,6 +670,7 @@ class Metrics:
                        path="engine")
                 _delta(w, _sr.W_BATCH_RPCS, self.frontdoor_batched_rpcs)
                 _delta(w, _sr.W_BATCH_FLUSHES, self.frontdoor_batch_flushes)
+                _delta(w, _sr.W_TRACE_DROPS, self.frontdoor_trace_drops)
                 if hub.chans:
                     self.shm_ring_depth.labels(worker=w).set(
                         hub.chans[i].sub_depth())

@@ -30,6 +30,17 @@ level of the BENCH json) must stay within the kernel-ladder budget —
 property of the traced program, so no fingerprint, no stash, and no
 rebase applies to it.
 
+A fifth gate is LOWER-IS-BETTER and host-keyed like the throughput
+gates: `measured_ms_per_window` (per-arm device time from the parsed
+jax.profiler trace, observability/devprof.py — recorded at the top
+level of the BENCH json when the census tier ran with
+GUBER_PROBE_MEASURE=1).  Wall-clock device time is a property of the
+box, so it compares against the same host's stash only, with its own
+looser noise floor (default 50%, GUBER_BENCH_MEASURED_TOLERANCE —
+single-digit-ms CPU kernels jitter far more than aggregate
+throughput).  The stash keeps the best-of (lowest) per arm and
+GUBER_BENCH_REBASE=1 re-anchors it along with the throughput metrics.
+
 Prior BENCH_r*.json rounds are still read (defensively: rc != 0 or an
 empty `parsed` is skipped, CPU numbers may live at the top level or
 nested under `cpu_smoke`) but only for CONTEXT in the log — they carry
@@ -103,14 +114,19 @@ def load_stash(path: str) -> dict:
         return {}
 
 
-def write_stash(path: str, fp: str, desc: str, metrics: dict) -> None:
+def write_stash(path: str, fp: str, desc: str, metrics: dict,
+                measured: dict | None = None) -> None:
     import time
+    rec = {"fingerprint": fp, "host": desc,
+           "anchored_at": int(time.time()),
+           "metrics": {m: float(v) for m, v in metrics.items()
+                       if isinstance(v, (int, float)) and v > 0}}
+    if measured:
+        rec["measured_ms_per_window"] = {
+            a: float(v) for a, v in measured.items()
+            if isinstance(v, (int, float)) and v > 0}
     with open(path, "w") as f:
-        json.dump({"fingerprint": fp, "host": desc,
-                   "anchored_at": int(time.time()),
-                   "metrics": {m: float(v) for m, v in metrics.items()
-                               if isinstance(v, (int, float)) and v > 0}},
-                  f, indent=2)
+        json.dump(rec, f, indent=2)
         f.write("\n")
 
 
@@ -217,6 +233,39 @@ def census_gate(fresh: dict) -> list[str]:
     return []
 
 
+def extract_measured(fresh: dict) -> dict:
+    """Per-arm measured ms/window from the fresh BENCH record (top level;
+    only present when the census tier ran with GUBER_PROBE_MEASURE=1)."""
+    m = fresh.get("measured_ms_per_window")
+    if not isinstance(m, dict):
+        return {}
+    return {a: float(v) for a, v in m.items()
+            if isinstance(v, (int, float)) and v > 0}
+
+
+def measured_compare(baseline_ms: dict, fresh_ms: dict,
+                     tolerance: float) -> list[str]:
+    """Lower-is-better device-time diff per arm (empty == gate passes).
+    Arms absent on either side are skipped, not failed: a cold stash or
+    a run without the measured pass must not trip the gate."""
+    failures = []
+    for arm in sorted(baseline_ms):
+        base = baseline_ms[arm]
+        new = fresh_ms.get(arm)
+        if not isinstance(new, (int, float)) or new <= 0:
+            print(f"  measured_ms[{arm}]: fresh value absent — skipped")
+            continue
+        ratio = new / base
+        verdict = "OK" if ratio <= 1.0 + tolerance else "REGRESSION"
+        print(f"  measured_ms[{arm}]: {new:.4f} vs best {base:.4f} "
+              f"({(ratio - 1.0) * 100.0:+.1f}%) {verdict}")
+        if verdict != "OK":
+            failures.append(
+                f"measured_ms[{arm}]: {new:.4f} > {base:.4f} * "
+                f"{1.0 + tolerance:.2f} ({(ratio - 1.0) * 100.0:+.1f}%)")
+    return failures
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--bench-dir",
@@ -231,6 +280,11 @@ def main(argv=None) -> int:
                                                 "0.10")),
                    help="allowed fractional drop before failing "
                    "(default 0.10)")
+    p.add_argument("--measured-tolerance", type=float,
+                   default=float(os.environ.get(
+                       "GUBER_BENCH_MEASURED_TOLERANCE", "0.50")),
+                   help="allowed fractional device-time rise before "
+                   "failing the measured gate (default 0.50)")
     p.add_argument("--budget", type=float, default=480.0,
                    help="wall budget (s) for the fresh bench.py run")
     args = p.parse_args(argv)
@@ -276,23 +330,36 @@ def main(argv=None) -> int:
             print(f"  {f_}", file=sys.stderr)
         return 1
 
+    fresh_ms = extract_measured(fresh)
+
     if rebase or not stash:
         if not gated:
             print("bench gate BROKEN: fresh run reported no gated metrics",
                   file=sys.stderr)
             return 2
-        write_stash(path, fp, desc, gated)
+        write_stash(path, fp, desc, gated, measured=fresh_ms)
         why = ("GUBER_BENCH_REBASE=1" if rebase
                else "first run on this host")
         print(f"bench gate: anchored baseline for {desc} "
               f"(fp {fp}) — {why}")
         for m, v in gated.items():
             print(f"  {m}: {v:,.0f}")
+        for a, v in sorted(fresh_ms.items()):
+            print(f"  measured_ms[{a}]: {v:.4f}")
         return 0
 
     baseline = stash["metrics"]
+    baseline_ms = stash.get("measured_ms_per_window")
+    if not isinstance(baseline_ms, dict):
+        baseline_ms = {}
     print(f"bench gate: baseline for {desc} (fp {fp})")
     failures = compare(baseline, fresh_cpu, args.tolerance)
+    if baseline_ms or fresh_ms:
+        print("bench gate: measured device time (lower is better)")
+        if not baseline_ms:
+            print("  measured_ms: no stash baseline — anchoring only")
+        failures += measured_compare(baseline_ms, fresh_ms,
+                                     args.measured_tolerance)
     if failures:
         print("bench gate FAILED:", file=sys.stderr)
         for f_ in failures:
@@ -306,8 +373,14 @@ def main(argv=None) -> int:
         if v > merged.get(m, 0.0):
             merged[m] = v
             raised.append(m)
+    # best-of for device time is the LOWEST per arm; new arms anchor
+    merged_ms = dict(baseline_ms)
+    for a, v in fresh_ms.items():
+        if a not in merged_ms or v < merged_ms[a]:
+            merged_ms[a] = v
+            raised.append(f"measured_ms[{a}]")
     if raised:
-        write_stash(path, fp, desc, merged)
+        write_stash(path, fp, desc, merged, measured=merged_ms)
         print(f"bench gate: baseline raised for {', '.join(raised)}")
     print("bench gate passed")
     return 0

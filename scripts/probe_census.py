@@ -12,6 +12,11 @@ The census is a property of the traced program, not the box it runs on —
 the same numbers come out on a laptop and on the pod — which is what
 makes it a gateable regression signal (scripts/bench_compare.py).
 
+The arm programs themselves live in observability/devprof.py
+(`build_census_arms`), shared with the measured device-time probe: the
+census count and the measured ms/window for an arm always come from the
+SAME traced program.
+
 Arms:
   int64_xla            one window, int64 oracle lowering
   compact32_xla        one window, compact-word XLA lowering
@@ -20,7 +25,10 @@ Arms:
   composed_analytics   K=8 composed drain + GLOBAL + analytics reduction
 
 Env: GUBER_PROBE_PLATFORM (cpu for smoke), GUBER_PROBE_JSON=<path> to
-also write the table as json.
+also write the table as json, GUBER_PROBE_MEASURE=1 to ALSO compile and
+run each arm under a real `jax.profiler` capture and report measured
+ms/window next to the census count (box-dependent — never gated
+absolutely, only against the same host's stash).
 """
 
 import json
@@ -33,14 +41,12 @@ from scripts._probe_env import setup as _setup  # noqa: E402
 _setup()
 
 import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
-import numpy as np  # noqa: E402
 
-from gubernator_tpu.config import AnalyticsConfig  # noqa: E402
-from gubernator_tpu.core import engine as em  # noqa: E402
-from gubernator_tpu.core.engine import RateLimitEngine  # noqa: E402
-from gubernator_tpu.ops import kernel, pallas_kernel as pk  # noqa: E402
-from gubernator_tpu.parallel.mesh import make_mesh  # noqa: E402
+from gubernator_tpu.observability.devprof import (  # noqa: E402
+    build_census_arms,
+    measure_census_arms,
+)
+from gubernator_tpu.ops import pallas_kernel as pk  # noqa: E402
 
 K = 8                    # serving stack depth the repo benches at
 DISPATCH_MS = 0.15       # per-kernel dispatch cost, BASELINE.md model
@@ -55,72 +61,46 @@ def census(fn, *args):
 
 
 def main():
-    mesh = make_mesh(jax.devices()[:1])
-    eng = RateLimitEngine(mesh=mesh, capacity_per_shard=256,
-                          batch_per_shard=64, global_capacity=32,
-                          global_batch_per_shard=8, max_global_updates=8)
-    S, B = eng.num_shards, eng.batch_per_shard
+    arms = build_census_arms(k=K)
 
     rows = []
-
-    def arm(name, total, windows):
-        kpw = total / windows
-        rows.append({"arm": name, "census_total": int(total),
-                     "windows": windows,
+    for spec in arms:
+        total = census(spec["fn"], *spec["args"])
+        kpw = total / spec["windows"]
+        rows.append({"arm": spec["name"], "census_total": int(total),
+                     "windows": spec["windows"],
                      "kernels_per_window": round(kpw, 1),
                      "projected_chip_decisions_per_sec":
                          int(PROJ_LANES / (kpw * DISPATCH_MS / 1000.0))})
 
-    # --- single-window arms -------------------------------------------
-    st1 = kernel.BucketState.zeros(eng.capacity_per_shard)
-    packed1 = jnp.zeros((B, 2), jnp.int64)
-
-    def xla64(state, packed, now):
-        return kernel.window_step(state, kernel.decode_batch(packed), now)
-
-    def c32(state, packed, now):
-        st, out = pk.window_step_compact32_xla(
-            state, kernel.decode_batch(packed), now)
-        return st, kernel.encode_output_word(out, now)
-
-    def fusedw(state, packed, now):
-        return pk.window_step_fused(state, packed, now, interpret=False)
-
-    arm("int64_xla", census(xla64, st1, packed1, jnp.int64(T0)), 1)
-    arm("compact32_xla", census(c32, st1, packed1, jnp.int64(T0)), 1)
-    arm("fused_window", census(fusedw, st1, packed1, jnp.int64(T0)), 1)
-
-    # --- composed drain arms (K windows per dispatch) -----------------
-    packed = np.zeros((K, S, B, 2), np.int64)
-    nows = np.full(K, T0, np.int64)
-    gb, ga, upd = eng.empty_drain_control()
-    f = em._compiled_pipeline_step_global_impl(eng.mesh, False, True, True)
-    arm("composed_drain",
-        census(f, eng.state, eng.gstate, eng.gcfg, packed, gb, ga, upd,
-               nows), K)
-
-    conf = AnalyticsConfig()
-    eng.enable_analytics(conf)
-    geom = (conf.sketch_depth, conf.sketch_width, conf.tenant_slots,
-            conf.topk, conf.over_weight)
-    f = em._compiled_pipeline_step_global_impl(eng.mesh, False, True, True,
-                                               geom)
-    ten = np.zeros((K, S, B), np.int32)
-    arm("composed_analytics",
-        census(f, eng.state, eng.gstate, eng.gcfg, packed, gb, ga, upd,
-               nows, eng._an_sketch, ten, jnp.int64(0)), K)
+    measured = None
+    if os.environ.get("GUBER_PROBE_MEASURE") == "1":
+        measured = measure_census_arms(arms=arms)
+        for r in rows:
+            m = measured["arms"].get(r["arm"])
+            if m is not None:
+                r["measured_ms_per_window"] = m["measured_ms_per_window"]
 
     hdr = (f"{'arm':<20} {'census':>7} {'win':>4} {'kern/win':>9} "
-           f"{'proj decisions/s':>17}")
+           f"{'proj decisions/s':>17}"
+           + (f" {'meas ms/win':>12}" if measured else ""))
     print(hdr)
     print("-" * len(hdr))
     for r in rows:
-        print(f"{r['arm']:<20} {r['census_total']:>7} {r['windows']:>4} "
-              f"{r['kernels_per_window']:>9} "
-              f"{r['projected_chip_decisions_per_sec']:>17,}")
+        line = (f"{r['arm']:<20} {r['census_total']:>7} {r['windows']:>4} "
+                f"{r['kernels_per_window']:>9} "
+                f"{r['projected_chip_decisions_per_sec']:>17,}")
+        if measured:
+            line += f" {r.get('measured_ms_per_window', 0.0):>12.4f}"
+        print(line)
 
     out = {"k_stack": K, "lanes_per_window": PROJ_LANES,
            "dispatch_ms_per_kernel": DISPATCH_MS, "arms": rows}
+    if measured is not None:
+        out["measured_ms_per_window"] = {
+            name: m["measured_ms_per_window"]
+            for name, m in measured["arms"].items()}
+        out["measured_kernel_table"] = measured["kernel_table"]
     path = os.environ.get("GUBER_PROBE_JSON")
     if path:
         with open(path, "w") as fh:

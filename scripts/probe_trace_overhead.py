@@ -14,6 +14,15 @@ bar is <5% for the OFF case relative to the median of its own warm
 rounds (i.e. the disabled-path cost is noise), and the ON case is
 reported for the record — sampling at 1.0 is a debugging posture, not a
 production one.
+
+A third sweep runs with the continuous device profiler armed
+(GUBER_DEVPROF=periodic, observability/devprof.py): the controller
+re-arms short jax.profiler captures on a background thread while the
+sweep drains, so the median round shows what always-on attribution
+costs the serving path.  This one IS asserted: overhead past
+GUBER_DEVPROF_OVERHEAD_PCT (default 2.0, median-of-rounds so a lone
+capture round cannot trip it) exits nonzero, which is how
+`make bench-smoke` gates the continuous mode.
 """
 import asyncio
 import os
@@ -43,10 +52,16 @@ def make_reqs():
     ]
 
 
-async def sweep(sample: float) -> float:
+async def sweep(sample: float, devprof: bool = False) -> float:
     conf = Config(engine=EngineConfig(capacity_per_shard=4096,
                                       batch_per_shard=1024))
     conf.trace_sample = sample
+    if devprof:
+        # continuous mode with an interval short enough that captures
+        # actually land inside the sweep (the controller sheds overlaps)
+        conf.devprof_mode = "periodic"
+        conf.devprof_interval_s = 0.5
+        conf.devprof_drains = 2
     inst = Instance(conf)
     inst.engine.warmup()
     reqs = make_reqs()
@@ -63,14 +78,25 @@ async def sweep(sample: float) -> float:
     return statistics.median(rates)
 
 
-async def main():
+async def main() -> int:
     off = await sweep(0.0)
     on = await sweep(1.0)
+    dev = await sweep(0.0, devprof=True)
     overhead = (off - on) / off * 100.0
+    dev_overhead = (off - dev) / off * 100.0
+    budget = float(os.environ.get("GUBER_DEVPROF_OVERHEAD_PCT", "2.0"))
     print(f"tracing off: {off:,.0f} decisions/s")
     print(f"tracing on (sample=1.0): {on:,.0f} decisions/s")
     print(f"sampled-vs-off overhead: {overhead:+.1f}%")
+    print(f"devprof periodic: {dev:,.0f} decisions/s")
+    print(f"devprof-vs-off overhead: {dev_overhead:+.1f}% "
+          f"(budget {budget:.1f}%)")
+    if dev_overhead > budget:
+        print(f"FAIL: continuous devprof costs {dev_overhead:.1f}% "
+              f"> {budget:.1f}% budget", file=sys.stderr)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":
-    asyncio.run(main())
+    sys.exit(asyncio.run(main()))

@@ -63,6 +63,11 @@ NATIVE_NAMES = (
     "guber_tpu_tier_events_total",
     "guber_tpu_tier_warm_rows",
     "guber_tpu_tier_warm_bytes",
+    # device-time flight recorder (observability/devprof.py)
+    "guber_tpu_device_window_ms",
+    "guber_tpu_device_window_ewma_ms",
+    "guber_tpu_devprof_captures",
+    "guber_tpu_frontdoor_trace_drops",
 )
 
 

@@ -371,6 +371,80 @@ def test_debug_endpoint_snapshot(admin, loop):
     run(loop, body())
 
 
+def test_chain_fetch_stage_accounting_stride4():
+    """Deferred-fetch chain accounting: with a fetch stride of 4 every
+    chained member reports the SHARED stacked-fetch window as one
+    `chain_fetch` span, the stage histogram sees ONE chain_fetch
+    observation per chained group (not per member — the shared stamps
+    must not over-count the fetch stride x), and the decomposition still
+    reconciles with the burst's wall time."""
+    import time
+
+    from gubernator_tpu import native
+    from gubernator_tpu.config import BehaviorConfig
+    from gubernator_tpu.core.batcher import WindowBatcher
+    from gubernator_tpu.core.engine import RateLimitEngine
+
+    if not native.available():
+        pytest.skip("native router unavailable")
+    eng = RateLimitEngine(capacity_per_shard=256, batch_per_shard=64,
+                          global_capacity=16, global_batch_per_shard=8,
+                          max_global_updates=8, use_native="on")
+    m = Metrics()
+    tr = Tracer(sample=1.0, export="")
+    b = WindowBatcher(eng, BehaviorConfig(), metrics=m, tracer=tr)
+    p = b.pipeline
+    assert p is not None and p.enabled
+    p.gate_enabled = False
+    p.coalesce_wait = 0.0
+    p.depth = 5
+    p.fetch_stride = 4
+    p.fetch_stride_max = max(4, p.fetch_stride_max)
+    p.chain_linger = 5.0
+    batches = [[RateLimitReq(name="cf", unique_key=f"s{w}k{i}", hits=1,
+                             limit=50, duration=60_000)
+                for i in range(8)] for w in range(4)]
+
+    async def run_burst():
+        # hold the engine thread so the pumped drains queue up and chain
+        p._engine_executor.submit(time.sleep, 0.1)
+        tasks = []
+        for batch in batches:
+            with tr.start_trace("rpc"):
+                tasks.append(asyncio.ensure_future(b.submit_now(batch)))
+            await asyncio.sleep(0)  # let this batch pump its own drain
+        return await asyncio.gather(*tasks)
+
+    t0 = time.monotonic()
+    try:
+        got = asyncio.run(run_burst())
+    finally:
+        b.close()
+    wall_ms = (time.monotonic() - t0) * 1000.0
+    assert all(len(rs) == 8 for rs in got)
+    assert p.fetch_elided >= 1, "no chain formed at stride 4"
+
+    chain = [s for s in tr.spans() if s.name == "chain_fetch"]
+    assert chain, "no chain_fetch span recorded for chained members"
+    assert all(s.duration > 0 for s in chain)
+
+    reg = m.registry
+    cf_count = reg.get_sample_value("guber_tpu_stage_duration_ms_count",
+                                    {"stage": "chain_fetch"})
+    assert cf_count is not None and cf_count >= 1.0
+    # one observation per GROUP: 4 drains minus the collapsed round trips
+    assert cf_count <= 4 - p.fetch_elided
+
+    def s_sum(stage):
+        return reg.get_sample_value("guber_tpu_stage_duration_ms_sum",
+                                    {"stage": stage}) or 0.0
+
+    ds = sum(s_sum(s) for s in ("window_fill", "device_dispatch",
+                                "drain_commit", "chain_fetch"))
+    assert ds > 0.0
+    assert ds <= wall_ms * 2.0 + 50.0, (ds, wall_ms)
+
+
 def test_profile_endpoint_arms_capture(admin, loop, monkeypatch):
     client, inst = admin
     calls = []
