@@ -1,7 +1,7 @@
 # Dev targets (the reference Makefile:1-15 has only release/docker; we add
 # the working set).
 
-.PHONY: test test-core test-pallas test-mesh-fused test-snapshot test-qos test-obs test-chaos test-analytics test-overlap test-chain test-frontdoor test-tiers test-devprof proto bench bench-smoke docker lint cluster
+.PHONY: test test-core test-pallas test-mesh-fused test-fused-staging test-snapshot test-qos test-obs test-chaos test-analytics test-overlap test-chain test-frontdoor test-tiers test-devprof proto bench bench-smoke docker lint cluster
 
 test:
 	python -m pytest tests/ -x -q
@@ -20,6 +20,13 @@ test-pallas:
 # Part of tier-1 (`test-core` picks it up too); this target runs just the slice.
 test-mesh-fused:
 	python -m pytest tests/ -x -q -m "mesh_fused and not slow"
+
+# the fused-staging differential seeds: packed-wire windows through the
+# K-grid drain + staged GLOBAL/analytics kernels vs the host
+# decode→oracle→encode path, replay-fallback shapes included.  Part of
+# tier-1 (`test-core` picks it up too); this target runs just the slice.
+test-fused-staging:
+	python -m pytest tests/ -x -q -m "fused_staging and not slow"
 
 # the state-lifecycle slice: snapshot/restore restart equivalence + live
 # key migration on ring change.  Part of tier-1 (`test-core` picks it up

@@ -193,6 +193,15 @@ def build_app(instance: Instance) -> web.Application:
             from gubernator_tpu.observability.devprof import census_table
             census = await _aio.get_running_loop().run_in_executor(
                 None, census_table)
+            # keep the scoreboard gauge current with the freshly traced
+            # table (startup publishes the same number; see
+            # Instance._publish_census)
+            metrics = getattr(instance, "metrics", None)
+            if metrics is not None and census:
+                arm = census.get("composed_analytics") \
+                    or census.get("composed_drain")
+                if arm:
+                    metrics.kernels_per_window.set(arm)
         measured = None
         if q.get("measure") in ("1", "true"):
             if instance.batcher.profile.armed:

@@ -34,6 +34,18 @@ FETCH_STRIDE_DEFAULT = 1
 FETCH_STRIDE_MAX_DEFAULT = 8
 CHAIN_LINGER_MS_DEFAULT = 2.0
 
+# Serving-lowering ladder (core/engine.py) — env-only perf knobs, all read
+# through env_bool at BUILD time (they key the compiled-builder cache, so
+# flipping one mid-process only affects executables built afterwards):
+#   GUBER_PALLAS=1          per-op Pallas lowerings (default: XLA)
+#   GUBER_PALLAS_FUSED=1    the fused serving-window megakernel
+#   GUBER_PALLAS_STAGED=0   opt OUT of the staged drain (default ON when
+#                           fused): K-grid drain kernel + pair-GLOBAL
+#                           kernel + analytics finisher — the folded
+#                           single-digit kernels/window ladder.  0 reverts
+#                           to the lax.scan drain skeleton for bisection.
+#   GUBER_COMPACT32_XLA=0   opt out of the compact32 XLA window body
+
 
 @dataclass
 class BehaviorConfig:

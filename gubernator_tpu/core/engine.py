@@ -2515,6 +2515,21 @@ def _use_pallas_fused() -> bool:
     return fused_enabled(False)
 
 
+def _use_pallas_staged() -> bool:
+    """Default-on STAGED drain lowering (GUBER_PALLAS_STAGED=0 reverts to
+    the K-scan of single-window megakernels): with the fused megakernel
+    enabled, the pipeline drain's K windows run as ONE pallas_call with a
+    K-major grid dimension (the arena carried across grid steps through
+    the aliased planes) and the GLOBAL sub-window's transition ladder runs
+    as one pair-arithmetic kernel — the composed drain traces to O(1)
+    kernels total instead of K pallas_calls plus the scan/staging/GLOBAL
+    shoulders.  No effect unless GUBER_PALLAS_FUSED is on.  Same
+    read-at-build-time discipline as _use_pallas: part of each compiled
+    builder's cache key, never read mid-trace."""
+    from gubernator_tpu.config import env_bool
+    return env_bool("GUBER_PALLAS_STAGED", True)
+
+
 def _recursion_guarded(fn):
     """Wrap a compiled executable so every call runs under the Mosaic
     recursion-limit guard (ops/pallas_kernel.py mosaic_recursion_guard).
@@ -2630,7 +2645,8 @@ def _apply_config(gstate: BucketState, gcfg: GlobalConfig, upd):
 
 
 def _global_window(gstate: BucketState, gcfg: GlobalConfig, gb: WindowBatch,
-                   gacc_row, now, mesh: Mesh, pallas: bool):
+                   gacc_row, now, mesh: Mesh, pallas: bool,
+                   staged: bool = False):
     """One window of GLOBAL traffic: replica reads + the reconciliation psum.
 
     The whole GLOBAL dance — the reference's async hit send plus owner
@@ -2643,6 +2659,18 @@ def _global_window(gstate: BucketState, gcfg: GlobalConfig, gb: WindowBatch,
         jnp.zeros_like(gstate.remaining), gb._replace(hits=gacc_row)
     )
     summed = lax.psum(delta, SHARD_AXIS)
+    if staged:
+        # The whole read+apply transition ladder as ONE pallas_call: the
+        # i64 arena crosses as bitcast (lo, hi) i32 pairs (Mosaic has no
+        # 64-bit vectors) and the ladder runs in exact pair arithmetic;
+        # only the leaky path's two integer divisions stay in XLA
+        # (kernel.transition_precompute) — they depend solely on pre-psum
+        # data, so hoisting them is bit-free.  fused_out: the read half
+        # comes back as the wire's gfused block i64[Bg, 4] directly.
+        from gubernator_tpu.ops.pallas_kernel import global_combined_staged
+        return global_combined_staged(gstate, gcfg, gb, summed, now,
+                                      interpret=_mesh_on_cpu(mesh),
+                                      fused_out=True)
     # Pallas GLOBAL apply only in interpret mode (CPU meshes/tests): the
     # kernel is int64 and Mosaic has no 64-bit vectors on real TPU, and
     # unlike the serving window the GLOBAL arena is EXEMPT from the
@@ -2829,12 +2857,14 @@ def _compiled_global_register(mesh: Mesh):
 def _compiled_pipeline_step(mesh: Mesh):
     return _compiled_pipeline_step_impl(mesh, _use_pallas(),
                                         _use_compact32_xla(),
-                                        _use_pallas_fused())
+                                        _use_pallas_fused(),
+                                        _use_pallas_staged())
 
 
 @lru_cache(maxsize=None)
 def _compiled_pipeline_step_impl(mesh: Mesh, pallas: bool,
-                                 c32xla: bool, fused: bool = False):
+                                 c32xla: bool, fused: bool = False,
+                                 staged: bool = False):
     """K compact serving windows in ONE device dispatch — the drain
     executable of the serving pipeline (core/pipeline.py).
 
@@ -2859,9 +2889,10 @@ def _compiled_pipeline_step_impl(mesh: Mesh, pallas: bool,
     """
     def shard_fn(state, packed, nows):
         # Block shapes: state [1, C]; packed [K, 1, B, 2]; nows [K].
-        st = BucketState(*jax.tree.map(lambda a: a[0], state))
-        st, words, limits, mism = _drain_scan(mesh, pallas, c32xla, fused,
-                                              st, packed, nows)
+        st = BucketState(*jax.tree.map(lambda a: lax.squeeze(a, (0,)),
+                                       state))
+        st, words, limits, mism, _ = _drain_scan(mesh, pallas, c32xla, fused,
+                                                 staged, st, packed, nows)
         expand = lambda a: a[None]
         return (
             BucketState(*jax.tree.map(expand, st)),
@@ -2887,16 +2918,37 @@ def _compiled_pipeline_step_impl(mesh: Mesh, pallas: bool,
 
 
 def _drain_scan(mesh: Mesh, pallas: bool, c32xla: bool, fused: bool,
-                st: BucketState, packed, nows):
-    """The drain's regular-key K-scan (shared by the regular and the
+                staged: bool, st: BucketState, packed, nows,
+                tenants=None, tenant_slots: int = 0):
+    """The drain's regular-key K windows (shared by the regular and the
     GLOBAL-composed drain executables): K compact windows applied
     sequentially to one shard's block, each window's decode→transition→
     word-encode either fused into ONE pallas_call or lowered per-op by
-    compact32-XLA.  Returns (state, words[K,B], limits[K,B], mism[K])."""
+    compact32-XLA.  With `staged` the K windows collapse further: the
+    lax.scan of single-window megakernels becomes ONE pallas_call with a
+    K-major grid dimension whose aliased plane outputs carry the arena
+    across grid steps — the drain traces to a single kernel.  When
+    `tenants` is given (staged only), the per-drain analytics reductions
+    (dense/tenant/header sums) accumulate inside that same kernel and
+    come back as `dstats` (see ops/analytics.py staged_stats_tail).
+    Returns (state, words[K,B], limits[K,B], mism[K], dstats-or-None)."""
     # Fused megakernel needs a power-of-two lane count for its in-kernel
     # bitonic sort; other widths fall back to compact32-XLA (B static).
     B = packed.shape[-2]
     use_fused = fused and (B & (B - 1)) == 0
+    use_staged = use_fused and staged
+
+    if use_staged:
+        from gubernator_tpu.ops.pallas_kernel import (
+            fused_state_from_planes,
+            fused_state_to_planes,
+            window_drain_fused_planes,
+        )
+        st32, words, limits, mism, dstats = window_drain_fused_planes(
+            fused_state_to_planes(st), lax.squeeze(packed, (1,)), nows,
+            interpret=_mesh_on_cpu(mesh),
+            tenants=tenants, tenant_slots=tenant_slots)
+        return fused_state_from_planes(st32), words, limits, mism, dstats
 
     def body(st, xs):
         pk, now = xs
@@ -2932,7 +2984,7 @@ def _drain_scan(mesh: Mesh, pallas: bool, c32xla: bool, fused: bool,
         st = fused_state_from_planes(st32)
     else:
         st, (words, limits, mism) = lax.scan(body, st, (packed, nows))
-    return st, words, limits, mism
+    return st, words, limits, mism, None
 
 
 @lru_cache(maxsize=None)
@@ -2971,12 +3023,14 @@ def _compiled_pipeline_step_global(mesh: Mesh, analytics=None):
     return _compiled_pipeline_step_global_impl(mesh, _use_pallas(),
                                                _use_compact32_xla(),
                                                _use_pallas_fused(),
+                                               _use_pallas_staged(),
                                                analytics)
 
 
 @lru_cache(maxsize=None)
 def _compiled_pipeline_step_global_impl(mesh: Mesh, pallas: bool,
                                         c32xla: bool, fused: bool = False,
+                                        staged: bool = False,
                                         analytics=None):
     """The mesh serving drain: _compiled_pipeline_step's K-scan PLUS one
     GLOBAL reconciliation window composed around it — the lockstep tick's
@@ -3014,15 +3068,29 @@ def _compiled_pipeline_step_global_impl(mesh: Mesh, pallas: bool,
         # [1, Bg]; gstate/gcfg [G] (replicated); upd [Kg] (replicated);
         # nows [K]; analytics extras: sketch [1, D, W]; tenants [K, 1, B];
         # decay [].
-        st = BucketState(*jax.tree.map(lambda a: a[0], state))
-        st, words, limits, mism = _drain_scan(mesh, pallas, c32xla, fused,
-                                              st, packed, nows)
+        # Squeezes, not [0]-indexing: each a[0] traces as slice+squeeze (2
+        # census equations per leaf) where squeeze alone is 1 — the staged
+        # ladder's budget counts every surviving op, and the shard_map
+        # block-unpack glue is most of what remains around the kernels.
+        sq = lambda a: lax.squeeze(a, (0,))
+        sq1 = lambda a: lax.squeeze(a, (1,))
+        st = BucketState(*jax.tree.map(sq, state))
+        # With staged analytics the drain kernel itself accumulates the
+        # dense/tenant/header sums (dstats) while it drains — the stats
+        # tail below then only runs the one-kernel sketch/top-k finish.
+        drain_tenants, drain_slots = None, 0
+        if analytics is not None and staged:
+            drain_tenants, drain_slots = sq1(an[1]), analytics[2]
+        st, words, limits, mism, dstats = _drain_scan(
+            mesh, pallas, c32xla, fused, staged, st, packed, nows,
+            tenants=drain_tenants, tenant_slots=drain_slots)
 
         gstate, gcfg = _apply_config(gstate, gcfg, upd)
-        gb = WindowBatch(*jax.tree.map(lambda a: a[0], gbatch))
-        new_g, gout = _global_window(gstate, gcfg, gb, gacc[0], nows[0],
-                                     mesh, pallas)
-        gfused = jnp.stack(
+        gb = WindowBatch(*jax.tree.map(sq, gbatch))
+        new_g, gout = _global_window(gstate, gcfg, gb, sq(gacc), nows[0],
+                                     mesh, pallas, staged=staged)
+        # staged hands back the gfused wire block straight from the kernel
+        gfused = gout if staged else jnp.stack(
             [gout.status.astype(jnp.int64), gout.limit, gout.remaining,
              gout.reset_time], axis=-1)
 
@@ -3037,13 +3105,23 @@ def _compiled_pipeline_step_global_impl(mesh: Mesh, pallas: bool,
             gcfg,
         )
         if analytics is not None:
-            from gubernator_tpu.ops import analytics as ops_analytics
             _, _, tenant_slots, topk, over_weight = analytics
             sketch, tenants, decay = an
-            sk, stats = ops_analytics.shard_stats(
-                sketch[0], packed[:, 0], words, tenants[:, 0], st.expire,
-                nows[0], decay, tenant_slots=tenant_slots, topk=topk,
-                over_weight=over_weight)
+            if dstats is not None:
+                from gubernator_tpu.ops.pallas_kernel import (
+                    staged_stats_finish,
+                )
+                sk, stats = staged_stats_finish(
+                    sq(sketch), dstats, st.expire, nows[0], decay,
+                    tenant_slots=tenant_slots, topk=topk,
+                    over_weight=over_weight,
+                    interpret=_mesh_on_cpu(mesh))
+            else:
+                from gubernator_tpu.ops import analytics as ops_analytics
+                sk, stats = ops_analytics.shard_stats(
+                    sq(sketch), sq1(packed), words, sq1(tenants), st.expire,
+                    nows[0], decay, tenant_slots=tenant_slots, topk=topk,
+                    over_weight=over_weight)
             outs = outs + (sk[None], stats[None])
         return outs
 

@@ -615,9 +615,16 @@ class DispatchPipeline:
         # fused-path adoption (observability): does this engine's drain
         # lower to the fused megakernel?  Read once — same build-time
         # discipline as the engine's compiled-builder cache keys.
+        from gubernator_tpu.core.engine import _use_pallas_staged
         from gubernator_tpu.ops.pallas_kernel import fused_enabled
         B = engine.batch_per_shard
         self.fused_serving = fused_enabled(False) and (B & (B - 1)) == 0
+        # staged drain (ISSUE 17): the fused windows further collapse into
+        # ONE K-grid pallas_call plus the pair-GLOBAL and analytics
+        # finisher kernels — single-digit kernels/window.  Same read-once
+        # build-time discipline: the engine's compiled builders key on the
+        # same flag, so this mirrors what the drains actually lower to.
+        self.staged_serving = self.fused_serving and _use_pallas_staged()
         self._in_flight = 0
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         # observability: RPCs fully served by this lane (tests assert the

@@ -555,13 +555,13 @@ def build_census_arms(k: int = 8):
     nows = np.full(k, t0, np.int64)
     gb, ga, upd = eng.empty_drain_control()
     fdrain = em._compiled_pipeline_step_global_impl(eng.mesh, False, True,
-                                                    True)
+                                                    True, True)
     conf = AnalyticsConfig()
     eng.enable_analytics(conf)
     geom = (conf.sketch_depth, conf.sketch_width, conf.tenant_slots,
             conf.topk, conf.over_weight)
     fan = em._compiled_pipeline_step_global_impl(eng.mesh, False, True, True,
-                                                 geom)
+                                                 True, geom)
     ten = np.zeros((k, s, b), np.int32)
 
     one = (st1, packed1, jnp.int64(t0))

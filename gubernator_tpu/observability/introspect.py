@@ -190,6 +190,7 @@ def build_debug_snapshot(instance) -> dict:
             "decisions_staged": pipe.decisions_staged,
             "lanes_staged": pipe.lanes_staged,
             "fused_serving": pipe.fused_serving,
+            "staged_serving": pipe.staged_serving,
             "lockstep": pipe.lockstep,
             "depth": pipe.depth,
             "overlap": pipe.overlap_snapshot(),

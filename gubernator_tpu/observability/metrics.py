@@ -169,6 +169,18 @@ class Metrics:
             buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
             registry=self.registry,
         )
+        # kernel-ladder scoreboard (daemon boot + the devprof admin
+        # endpoint): executed-kernel census of the composed serving
+        # arm, kernels per window.  A property of the traced program — the
+        # same number on every box — so a step in this gauge across a
+        # deploy IS a serving-ladder regression (scripts/bench_compare.py
+        # gates the same census absolutely)
+        self.kernels_per_window = Gauge(
+            "guber_tpu_kernels_per_window",
+            "Executed-kernel census of the composed serving window "
+            "(traced-program property; lower is better).",
+            registry=self.registry,
+        )
         # overlapped drain pipeline (core/pipeline.py): concurrent drains in
         # flight, the host/device/fetch overlap achieved, and staging arena
         # recycling (core/window_buffers.py) — overlap_ratio is

@@ -333,6 +333,20 @@ def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh,
     return _Reg(*new_reg), WindowOutput(*out)
 
 
+def transition_precompute(reg_duration, reg_tstamp, req_limit, now):
+    """The two integer divisions of `transition`'s leaky path, factored out
+    so a Mosaic lowering can run them in int64 XLA BEFORE entering a pair-
+    arithmetic kernel (ops/pallas_kernel.py global_combined_staged): both
+    depend only on pre-psum data (stored duration/tstamp + request limit),
+    never on the evolving balance, so hoisting them is exact.  Must stay
+    textually in lockstep with transition's rate/leak lines above."""
+    ONE = jnp.asarray(1, reg_duration.dtype)
+    rate = reg_duration // jnp.maximum(req_limit, ONE)
+    rate = jnp.maximum(rate, ONE)
+    leak = (now - reg_tstamp) // rate
+    return rate, leak
+
+
 def fold_entering(reg: _Reg, fresh0, h0, l0, d0, a0, pos, nz, n_lead,
                   hstar, now):
     """Closed-form ENTERING register for lane `pos` of a foldable segment

@@ -6,7 +6,14 @@ normalizes to kernels **per request window** at the serving stack depth
 K=8, and projects on-chip decision throughput from the repo's dispatch
 cost model (BASELINE.md): a serving window is dispatch-bound, so
 
-    projected decisions/s ~= lanes_per_window / (kpw * DISPATCH_MS / 1000)
+    projected decisions/s ~= lanes_per_window / (kpw * overhead_ms / 1000)
+
+where overhead_ms is the per-kernel window cost.  When a profiler
+capture is available (GUBER_PROBE_MEASURE=1) the probe re-derives it
+empirically per arm — measured_ms_per_window / kernels_per_window —
+so the projection tracks the arm's real dispatch cost instead of the
+BASELINE.md constant; without a capture it falls back to the
+DISPATCH_MS=0.15 model constant and says so.
 
 The census is a property of the traced program, not the box it runs on —
 the same numbers come out on a laptop and on the pod — which is what
@@ -69,9 +76,7 @@ def main():
         kpw = total / spec["windows"]
         rows.append({"arm": spec["name"], "census_total": int(total),
                      "windows": spec["windows"],
-                     "kernels_per_window": round(kpw, 1),
-                     "projected_chip_decisions_per_sec":
-                         int(PROJ_LANES / (kpw * DISPATCH_MS / 1000.0))})
+                     "kernels_per_window": round(kpw, 1)})
 
     measured = None
     if os.environ.get("GUBER_PROBE_MEASURE") == "1":
@@ -81,14 +86,34 @@ def main():
             if m is not None:
                 r["measured_ms_per_window"] = m["measured_ms_per_window"]
 
+    # Projection: prefer the arm's empirical per-kernel cost when a
+    # capture gave us measured ms/window; model constant otherwise.
+    fell_back = False
+    for r in rows:
+        kpw = r["kernels_per_window"]
+        meas = r.get("measured_ms_per_window")
+        if meas and meas > 0:
+            overhead = meas / kpw
+        else:
+            overhead = DISPATCH_MS
+            fell_back = True
+        r["overhead_ms_per_kernel"] = round(overhead, 4)
+        r["projected_chip_decisions_per_sec"] = \
+            int(PROJ_LANES / (kpw * overhead / 1000.0))
+    if fell_back:
+        print(f"# no profiler capture for some arms — projection uses "
+              f"the BASELINE.md DISPATCH_MS={DISPATCH_MS} constant there "
+              f"(set GUBER_PROBE_MEASURE=1 for empirical overhead)")
+
     hdr = (f"{'arm':<20} {'census':>7} {'win':>4} {'kern/win':>9} "
-           f"{'proj decisions/s':>17}"
+           f"{'ms/kern':>8} {'proj decisions/s':>17}"
            + (f" {'meas ms/win':>12}" if measured else ""))
     print(hdr)
     print("-" * len(hdr))
     for r in rows:
         line = (f"{r['arm']:<20} {r['census_total']:>7} {r['windows']:>4} "
                 f"{r['kernels_per_window']:>9} "
+                f"{r['overhead_ms_per_kernel']:>8} "
                 f"{r['projected_chip_decisions_per_sec']:>17,}")
         if measured:
             line += f" {r.get('measured_ms_per_window', 0.0):>12.4f}"

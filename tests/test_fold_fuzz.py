@@ -25,6 +25,14 @@ fold exactly or reject to the replay:
 Both lowerings are pinned: the int64 oracle path against the serial
 contract, and the compact32-XLA path against the int64 path on the same
 windows (all values inside the compact caps by construction).
+
+The fused-staging seeds push the SAME adversarial windows through the
+packed wire — compact-encoded requests in, response words out — and pin
+both fused layouts against the host decode → oracle → encode path: the
+K-grid staged drain (plane-form carry across grid steps) and K chained
+single-window megakernel calls on the int64 state.  The replay fallback
+inside the fused body is exercised by construction (hstar violations and
+AGG lanes inside multi-lane runs force fold_classify to bail).
 """
 
 import numpy as np
@@ -142,3 +150,101 @@ def test_fold_adversarial_segments_match_serial(seed):
             np.testing.assert_array_equal(
                 np.asarray(a), np.asarray(b),
                 err_msg=f"seed {seed} window {w} compact32 state.{name}")
+
+
+def _has_replay_shape(batch):
+    """True iff some duplicate run carries distinct nonzero hits (an hstar
+    violation) or an AGG lane inside a multi-lane run — the shapes
+    fold_classify must reject to the per-segment replay."""
+    slot = np.asarray(batch.slot)
+    hits = np.asarray(batch.hits)
+    valid = slot >= 0
+    clean = np.where(valid, slot & ~kernel.AGG_SLOT_BIT, -1)
+    agg = valid & ((slot & kernel.AGG_SLOT_BIT) != 0)
+    for s in np.unique(clean[valid]):
+        lanes = clean == s
+        nz = hits[lanes][hits[lanes] > 0]
+        if np.unique(nz).size > 1:
+            return True
+        if lanes.sum() > 1 and agg[lanes].any():
+            return True
+    return False
+
+
+@pytest.mark.fused_staging
+@pytest.mark.parametrize("seed", list(range(6)))
+def test_fused_staging_drain_matches_host_oracle(seed):
+    """Fused-staging differential: packed wire in / packed wire out through
+    the new K-grid drain body vs the host decode → int64 oracle → encode
+    path, on the fold fuzz's adversarial windows (replay-fallback shapes
+    guaranteed by construction).  Both layouts pinned: the plane-form grid
+    carry and K chained single-window fused calls on the int64 state."""
+    K, B, C = 4, 32, 24
+    rng = np.random.default_rng(9000 + seed)
+    st0 = _adversarial_state(rng, C, T0)
+
+    now = T0
+    nows, packs = [], []
+    saw_replay = False
+    for _ in range(K):
+        now += int(rng.integers(1, 300_000))
+        bt = _adversarial_batch(rng, B, C)
+        saw_replay |= _has_replay_shape(bt)
+        nows.append(now)
+        packs.append(np.asarray(kernel.encode_batch_host(
+            np.asarray(bt.slot), np.asarray(bt.hits),
+            np.asarray(bt.limit), np.asarray(bt.duration),
+            np.asarray(bt.algo), np.asarray(bt.is_init))))
+    assert saw_replay, "adversarial windows lost their replay shapes"
+    packed = jnp.asarray(np.stack(packs))
+    nows_j = jnp.asarray(np.asarray(nows, np.int64))
+
+    # host path: wire decode -> int64 oracle -> wire encode, per window
+    step = jax.jit(kernel.window_step)
+    st_ref = st0
+    ref_words, ref_limits, ref_mism = [], [], []
+    for k in range(K):
+        nj = jnp.int64(nows[k])
+        bt = kernel.decode_batch(packed[k])
+        st_ref, out = step(st_ref, bt, nj)
+        ref_words.append(np.asarray(kernel.encode_output_word(out, nj)))
+        ref_limits.append(np.asarray(out.limit))
+        ref_mism.append(bool(np.any(
+            (np.asarray(out.limit) != np.asarray(bt.limit))
+            & (np.asarray(bt.slot) >= 0))))
+
+    # layout 1: the staged K-grid drain, plane-form carry across grid steps
+    new32, words, limits, mism, stats = pk.window_drain_fused_planes(
+        pk.fused_state_to_planes(st0), packed, nows_j, interpret=True)
+    assert stats is None
+    np.testing.assert_array_equal(
+        np.asarray(words), np.stack(ref_words),
+        err_msg=f"seed {seed} drain response words")
+    np.testing.assert_array_equal(
+        np.asarray(limits), np.stack(ref_limits),
+        err_msg=f"seed {seed} drain limit lanes")
+    np.testing.assert_array_equal(
+        np.asarray(mism), np.asarray(ref_mism),
+        err_msg=f"seed {seed} drain mismatch flags")
+    for name, a, b in zip(kernel.BucketState._fields,
+                          pk.fused_state_from_planes(new32), st_ref):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"seed {seed} drain state.{name}")
+
+    # layout 2: K chained single-window fused calls on the int64 state
+    st_f = st0
+    for k in range(K):
+        st_f, w_f, l_f, m_f = pk.window_step_fused(
+            st_f, packed[k], jnp.int64(nows[k]), interpret=True)
+        np.testing.assert_array_equal(
+            np.asarray(w_f), ref_words[k],
+            err_msg=f"seed {seed} window {k} fused words")
+        np.testing.assert_array_equal(
+            np.asarray(l_f), ref_limits[k],
+            err_msg=f"seed {seed} window {k} fused limits")
+        assert bool(m_f) == ref_mism[k], f"seed {seed} window {k} fused mism"
+    for name, a, b in zip(kernel.BucketState._fields, st_f, st_ref):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"seed {seed} fused state.{name}")

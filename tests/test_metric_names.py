@@ -68,6 +68,8 @@ NATIVE_NAMES = (
     "guber_tpu_device_window_ewma_ms",
     "guber_tpu_devprof_captures",
     "guber_tpu_frontdoor_trace_drops",
+    # kernel-ladder scoreboard (daemon boot, staged drain)
+    "guber_tpu_kernels_per_window",
 )
 
 
