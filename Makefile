@@ -1,7 +1,7 @@
 # Dev targets (the reference Makefile:1-15 has only release/docker; we add
 # the working set).
 
-.PHONY: test test-core test-pallas test-mesh-fused test-fused-staging test-snapshot test-qos test-obs test-chaos test-analytics test-overlap test-chain test-frontdoor test-tiers test-devprof proto bench bench-smoke docker lint cluster
+.PHONY: test test-core test-pallas test-mesh-fused test-fused-staging test-snapshot test-qos test-obs test-chaos test-analytics test-overlap test-chain test-frontdoor test-tiers test-devprof test-algorithms proto bench bench-smoke docker lint cluster
 
 test:
 	python -m pytest tests/ -x -q
@@ -98,6 +98,15 @@ test-tiers:
 # too); this target runs just the slice.
 test-devprof:
 	python -m pytest tests/ -x -q -m "devprof and not slow"
+
+# the algorithm-plane slice: GCRA / sliding-window / concurrency ladders
+# bit-exact vs the plain-python serial oracles on every lowering (int64,
+# compact32-XLA, Pallas per-window, fused K-grid), the all-algorithm fold
+# fuzz seeds, lease-book lifecycle, out-of-range→token fallback, and
+# snapshot forward-compat row dropping.  Part of tier-1 (`test-core`
+# picks it up too); this target runs just the slice.
+test-algorithms:
+	python -m pytest tests/ -x -q -m "algorithms and not slow"
 
 proto:
 	cd gubernator_tpu/api/proto && protoc --python_out=. gubernator.proto peers.proto

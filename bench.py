@@ -456,6 +456,52 @@ def bench_host_sync(mesh, capacity, lanes, seconds=3.0):
     return per_sec
 
 
+def bench_algorithms(mesh, capacity, lanes, seconds=1.0):
+    """Algorithm-plane tier: one process() loop per wire algorithm —
+    token, leaky, GCRA, sliding-window, concurrency — plus a MIXED batch
+    with all five algorithms live in one window.  Runs through the
+    engine's adopted serving arm (on chip that is the fused Pallas path
+    when the A/B adopted it), so the numbers answer "what does each
+    transition ladder cost" next to the host-sync tier's token-only
+    figure."""
+    from gubernator_tpu.api.types import Algorithm, RateLimitReq
+    from gubernator_tpu.core.engine import RateLimitEngine
+
+    eng = RateLimitEngine(mesh=mesh, capacity_per_shard=capacity,
+                          batch_per_shard=lanes, global_capacity=1024,
+                          global_batch_per_shard=128, max_global_updates=128)
+    N = 500
+    now = 1_700_000_100_000
+
+    def reqs_for(tag, algo_of):
+        # concurrency lanes acquire one lease per round and never release
+        # during the bench, so give them a limit the run can't exhaust
+        return [RateLimitReq(
+                    name=f"alg_{tag}", unique_key=f"k{i}", hits=1,
+                    limit=(1_000_000 if algo_of(i) == Algorithm.CONCURRENCY
+                           else 100),
+                    duration=60_000, algorithm=algo_of(i))
+                for i in range(N)]
+
+    batches = [(a.name.lower(), reqs_for(a.name.lower(), lambda _i, a=a: a))
+               for a in Algorithm]
+    batches.append(("mixed", reqs_for("mixed",
+                                      lambda i: Algorithm(i % 5))))
+    eng.process(batches[0][1], now=now)  # compile the serving executables
+    out = {}
+    for tag, reqs in batches:
+        eng.process(reqs, now=now)  # warm THIS batch's slot-table rows
+        t0 = time.perf_counter()
+        iters = 0
+        while time.perf_counter() - t0 < seconds:
+            eng.process(reqs, now=now + 1 + iters)
+            iters += 1
+        out[tag] = round(iters * N / (time.perf_counter() - t0), 1)
+    log("# algorithms tier: " + ", ".join(
+        f"{t}={v:,.0f}/s" for t, v in out.items()))
+    return {"algorithms_decisions_per_sec": out}
+
+
 def bench_chain(mesh, capacity, lanes, strides=(1, 2, 4, 8), seconds=2.0,
                 rtt_s=0.0):
     """Deferred-fetch chain sweep: the serving drain loop (host re-stage ->
@@ -1238,6 +1284,10 @@ def child_main():
         sync_ps = bench_host_sync(mesh, capacity, lanes,
                                   seconds=2.0 if on_cpu else 3.0)
         tier["host_sync_decisions_per_sec"] = round(sync_ps, 1)
+        checkpoint()
+
+        tier.update(bench_algorithms(mesh, capacity, lanes,
+                                     seconds=1.0 if on_cpu else 2.0))
         checkpoint()
 
         sweep = bench_chain(mesh, capacity, lanes,

@@ -16,9 +16,16 @@ from typing import Dict, List, Optional
 
 
 class Algorithm(enum.IntEnum):
-    # reference proto/gubernator.proto:56-61
+    # reference proto/gubernator.proto:56-61; values 2..4 are the
+    # algorithm-plane extension (gubernator_tpu/algorithms/): GCRA,
+    # weighted sliding-window counters, and concurrency leases (negative
+    # hits releases held slots).  Out-of-range values degrade to
+    # TOKEN_BUCKET on-device (reference algorithms.go:100-104 fallback).
     TOKEN_BUCKET = 0
     LEAKY_BUCKET = 1
+    GCRA = 2
+    SLIDING_WINDOW = 3
+    CONCURRENCY = 4
 
 
 class Behavior(enum.IntEnum):

@@ -165,6 +165,38 @@ class QoSConfig:
 
 
 @dataclass
+class LeaseConfig:
+    """Concurrency-lease plane knobs (gubernator_tpu/algorithms/leases.py).
+    The device free-slot counters stay authoritative regardless; these
+    govern the host-side book that attributes held slots to clients."""
+
+    # Release a vanished client's held slots when the RPC that carried its
+    # acquires is torn down before the response is delivered (server.py
+    # stream-close hook).  Off leaves reclaim to bucket expiry alone.
+    release_on_stream_close: bool = field(
+        default_factory=lambda: env_bool("GUBER_LEASE_RELEASE_ON_CLOSE",
+                                         True))
+    # Periodic sweep of expired grants out of the book, ms (0 disables;
+    # the device already expired those buckets, the sweep only keeps the
+    # lease gauges honest).
+    sweep_interval_ms: int = field(
+        default_factory=lambda: env_int("GUBER_LEASE_SWEEP_MS", 5000,
+                                        minimum=0))
+    # Cap on slots one client may hold per key (0 = unlimited): an acquire
+    # that would exceed it is answered OVER_LIMIT on the host, before the
+    # device sees it.
+    max_per_client: int = field(
+        default_factory=lambda: env_int("GUBER_LEASE_MAX_PER_CLIENT", 0,
+                                        minimum=0))
+
+    def validate(self) -> None:
+        if self.sweep_interval_ms < 0:
+            raise ValueError("Lease.sweep_interval_ms must be >= 0")
+        if self.max_per_client < 0:
+            raise ValueError("Lease.max_per_client must be >= 0")
+
+
+@dataclass
 class HealthConfig:
     """Self-healing ring knobs (net/health.py + the hinted-handoff buffer
     in core/global_sync.py + the daemon drain phase).  No reference
@@ -369,6 +401,7 @@ class Config:
     analytics: AnalyticsConfig = field(default_factory=AnalyticsConfig)
     slo: SLOConfig = field(default_factory=SLOConfig)
     tiers: TierConfig = field(default_factory=TierConfig)
+    leases: LeaseConfig = field(default_factory=LeaseConfig)
     # advertise address used for self-identification in the peer ring
     advertise_address: str = ""
     # Request tracing (observability/tracing.py): probability a request
@@ -480,6 +513,7 @@ class DaemonConfig:
     analytics: AnalyticsConfig = field(default_factory=AnalyticsConfig)
     slo: SLOConfig = field(default_factory=SLOConfig)
     tiers: TierConfig = field(default_factory=TierConfig)
+    leases: LeaseConfig = field(default_factory=LeaseConfig)
 
     @property
     def k8s_enabled(self) -> bool:

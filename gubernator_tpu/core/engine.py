@@ -973,11 +973,14 @@ class RateLimitEngine:
                     # accurate on the mesh lockstep tick path so the
                     # pipeline drain may keep staging compact lanes.
                     occ = batches.slot >= 0
+                    dur_cap = np.where(
+                        batches.algo == kernel.SLIDING_WINDOW,
+                        kernel.SLIDING_MAX_DURATION,
+                        kernel.COMPACT_MAX_DURATION)
                     ok = bool((((batches.limit >= 0)
                                 & (batches.limit < kernel.COMPACT_MAX_LIMIT)
                                 & (batches.duration >= 0)
-                                & (batches.duration
-                                   < kernel.COMPACT_MAX_DURATION))
+                                & (batches.duration < dur_cap))
                                | ~occ).all())
                 else:
                     ok = False  # resident arrays: unscannable
@@ -1291,20 +1294,35 @@ class RateLimitEngine:
         legacy path): it maintains _compact_sound, which gates what the
         lockstep pipeline drain may STAGE in compact form."""
         if self._compact_sound:
+            # sliding-window rows halve the duration cap: the compact
+            # lowering's rebased-i32 exactness proof needs
+            # now - window_start < 2*duration (ops/kernel.py)
+            dur_cap = np.where(buf.algo == kernel.SLIDING_WINDOW,
+                               kernel.SLIDING_MAX_DURATION,
+                               kernel.COMPACT_MAX_DURATION)
             cfg_ok = (
                 bool((buf.limit >= 0).all())
                 and bool((buf.limit < kernel.COMPACT_MAX_LIMIT).all())
                 and bool((buf.duration >= 0).all())
-                and bool((buf.duration < kernel.COMPACT_MAX_DURATION).all())
+                and bool((buf.duration < dur_cap).all())
             )
             if not cfg_ok:
                 self._compact_enabled = False
                 self._compact_sound = False
         if not self._compact_enabled or not self._compact_sound:
             return False
+        # concurrency releases carry negative hits, sign-extended through
+        # bit 27 of the compact hits field; every other algorithm keeps the
+        # full non-negative 28-bit range.  Algorithms outside the 3-bit wire
+        # alphabet (0..4) take the full path, where the token fallback is
+        # applied without re-encoding.
+        conc = buf.algo == kernel.CONCURRENCY
+        h_lo = np.where(conc, 1 - kernel.CONC_MAX_HITS, 0)
+        h_hi = np.where(conc, kernel.CONC_MAX_HITS, kernel.COMPACT_MAX_HITS)
         return (
-            bool((buf.hits >= 0).all())
-            and bool((buf.hits < kernel.COMPACT_MAX_HITS).all())
+            bool(((buf.hits >= h_lo) & (buf.hits < h_hi)).all())
+            and bool(((buf.algo >= 0)
+                      & (buf.algo <= kernel.CONCURRENCY)).all())
         )
 
     def _sharded_in(self, local_np):

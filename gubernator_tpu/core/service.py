@@ -21,12 +21,16 @@ import logging
 import time
 from typing import Dict, List, Optional, Sequence
 
+from gubernator_tpu.algorithms.leases import LeaseBook
+from gubernator_tpu.algorithms.oracles import ALGORITHM_NAMES
 from gubernator_tpu.api.types import (
     Algorithm,
     Behavior,
     HealthCheckResp,
     RateLimitReq,
     RateLimitResp,
+    Status,
+    millisecond_now,
 )
 from gubernator_tpu.config import MAX_BATCH_SIZE, Config, PeerInfo
 from gubernator_tpu.core.batcher import WindowBatcher
@@ -93,6 +97,14 @@ class Instance:
         if self.conf.qos.enabled:
             self.qos = QoSManager(self.conf.qos, metrics=self.metrics)
             self.metrics.watch_qos(self.qos)
+        # Concurrency-lease book (algorithms/leases.py): host-side shadow
+        # of who holds which CONCURRENCY slots, so stream-close and peer
+        # death can release them and migration can re-register them.  The
+        # template map remembers how to rebuild a release request per key
+        # (the book itself stores only hash keys).
+        self.leases = LeaseBook()
+        self._lease_tmpl: Dict[str, RateLimitReq] = {}
+        self.metrics.watch_leases(self.leases)
         # Traffic analytics + SLO burn-rate engine (observability/
         # analytics.py).  Off by default: the pipeline then holds None and
         # the serving path is byte-identical to the seed (one attribute
@@ -238,31 +250,137 @@ class Instance:
     async def get_rate_limits(
         self, requests: Sequence[RateLimitReq],
         deadline: Optional[float] = None,
+        client_id: Optional[str] = None,
     ) -> List[RateLimitResp]:
         """deadline: absolute monotonic deadline propagated from the
         transport (gRPC context.time_remaining(), HTTP timeout header) —
         admission sheds requests it cannot serve in time (qos/admission.py).
+
+        client_id: transport-level caller identity (source address) — the
+        concurrency-lease book attributes grants to it so stream-close and
+        peer-death can release held slots.
         """
         if len(requests) > MAX_BATCH_SIZE:
             raise BatchTooLargeError(
                 f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'")
         return list(await asyncio.gather(
-            *(self._route(r, deadline) for r in requests)))
+            *(self._route(r, deadline, client_id=client_id)
+              for r in requests)))
 
     async def _route(self, r: RateLimitReq,
-                     deadline: Optional[float] = None) -> RateLimitResp:
+                     deadline: Optional[float] = None,
+                     client_id: Optional[str] = None) -> RateLimitResp:
+        cap = getattr(getattr(self.conf, "leases", None),
+                      "max_per_client", 0)
+        if (cap and r.algorithm == Algorithm.CONCURRENCY and r.hits > 0
+                and self.leases.count(client_id or "anonymous",
+                                      r.hash_key()) + r.hits > cap):
+            # GUBER_LEASE_MAX_PER_CLIENT: answer on the host, before the
+            # device spends a slot this client is not allowed to hold
+            resp = RateLimitResp(status=Status.OVER_LIMIT, limit=r.limit,
+                                 remaining=0, reset_time=0)
+            self._account_decision(r, resp, client_id)
+            return resp
+        if (r.algorithm == Algorithm.CONCURRENCY
+                and (r.hits < 0
+                     or (client_id is not None
+                         and self.leases.holds(client_id, r.hash_key())))):
+            # QoS exemption: shedding a lease release (or a holder's
+            # re-touch) on deadline would leak the held slot until bucket
+            # expiry — these always ride through admission undeadlined
+            deadline = None
+        resp = await self._route_inner(r, deadline)
+        self._account_decision(r, resp, client_id)
+        return resp
+
+    def _account_decision(self, r: RateLimitReq, resp: RateLimitResp,
+                          client_id: Optional[str]) -> None:
+        """Post-decision bookkeeping: the per-algorithm decision counter
+        and the concurrency-lease book (algorithms/leases.py)."""
+        if resp.error:
+            return
+        self.metrics.observe_algorithm(
+            ALGORITHM_NAMES.get(int(r.algorithm), "token_bucket"))
+        if r.algorithm != Algorithm.CONCURRENCY or r.hits == 0:
+            return
+        key = r.hash_key()
+        client = client_id or "anonymous"
+        if r.hits > 0:
+            if resp.status == Status.UNDER_LIMIT:
+                self._lease_tmpl[key] = r
+                self.leases.acquire(key, client, r.hits,
+                                    millisecond_now() + r.duration)
+        else:
+            self.leases.release(key, client, -r.hits)
+            self.metrics.observe_lease_release("explicit", -r.hits)
+
+    async def release_client_leases(self, client_id: str,
+                                    reason: str = "stream_close") -> int:
+        """Release every lease a vanished client holds: drop the book rows
+        and push the matching negative-hits requests through the normal
+        decision path so the device free-slot counters recover.  Returns
+        the number of slots given back."""
+        rows = self.leases.release_client(client_id)
+        total = 0
+        for key, count in rows:
+            tmpl = self._lease_tmpl.get(key)
+            if tmpl is None:
+                # no template (book restored from a snapshot and the key
+                # was never re-touched here): the bucket's expiry column
+                # reclaims the slots on-device
+                continue
+            rel = RateLimitReq(
+                name=tmpl.name, unique_key=tmpl.unique_key, hits=-count,
+                limit=tmpl.limit, duration=tmpl.duration,
+                algorithm=Algorithm.CONCURRENCY, behavior=tmpl.behavior)
+            resp = await self._route_inner(rel, None)
+            if not resp.error:
+                total += count
+        if total or rows:
+            self.metrics.observe_lease_release(
+                reason, sum(c for _, c in rows))
+        return total
+
+    async def release_peer_leases(self, host: str) -> int:
+        """Peer-death hook (net/health.py): grants are attributed to the
+        forwarding peer's source address, so a confirmed-down peer's
+        clients get their slots back here."""
+        ip = host.rsplit(":", 1)[0]
+        total = 0
+        for client in (host, ip):
+            if self.leases.holds(client):
+                total += await self.release_client_leases(
+                    client, reason="peer_down")
+        return total
+
+    async def _route_inner(self, r: RateLimitReq,
+                           deadline: Optional[float] = None
+                           ) -> RateLimitResp:
         key = r.hash_key()
         # validation: exact reference strings and order (gubernator.go:102-110)
         if not r.unique_key:
             return RateLimitResp(error="field 'unique_key' cannot be empty")
         if not r.name:
             return RateLimitResp(error="field 'namespace' cannot be empty")
-        if r.algorithm not in (Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET):
+        if r.algorithm not in (Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET,
+                               Algorithm.GCRA, Algorithm.SLIDING_WINDOW,
+                               Algorithm.CONCURRENCY):
             # the reference surfaces this via the apply-error wrapper
             # (gubernator.go:126-131 <- :250)
             return RateLimitResp(error=(
                 f"while applying rate limit for '{key}' - "
                 f"'invalid rate limit algorithm '{r.algorithm}''"))
+        if (r.behavior == Behavior.GLOBAL
+                and r.algorithm not in (Algorithm.TOKEN_BUCKET,
+                                        Algorithm.LEAKY_BUCKET)):
+            # the staged GLOBAL pair-transition replicates only the
+            # token/leaky ladders; GCRA/sliding/concurrency state cannot be
+            # reconciled through the hits psum, so refuse rather than
+            # silently serve stale replicas
+            return RateLimitResp(error=(
+                f"while applying rate limit for '{key}' - "
+                f"'GLOBAL behavior does not support algorithm "
+                f"'{r.algorithm}''"))
 
         # standalone (no peer ring): every key is ours
         if self._picker.size() == 0:
@@ -444,7 +562,9 @@ class Instance:
 
     # ------------------------------------------------------------ peer plane
 
-    async def get_peer_rate_limits(self, requests: Sequence[RateLimitReq]) -> List[RateLimitResp]:
+    async def get_peer_rate_limits(
+            self, requests: Sequence[RateLimitReq],
+            client_id: Optional[str] = None) -> List[RateLimitResp]:
         """Batch relay from a peer; we must be authoritative for every key
         (gubernator.go:210-227)."""
         if len(requests) > MAX_BATCH_SIZE:
@@ -454,7 +574,10 @@ class Instance:
         slots: List[int] = []
         out: List[Optional[RateLimitResp]] = [None] * len(requests)
         for i, r in enumerate(requests):
-            if r.algorithm not in (Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET):
+            if r.algorithm not in (Algorithm.TOKEN_BUCKET,
+                                   Algorithm.LEAKY_BUCKET, Algorithm.GCRA,
+                                   Algorithm.SLIDING_WINDOW,
+                                   Algorithm.CONCURRENCY):
                 out[i] = RateLimitResp(
                     error=f"invalid rate limit algorithm '{r.algorithm}'")
                 continue
@@ -466,6 +589,9 @@ class Instance:
             resps = await self.batcher.submit_now(valid)
             for i, resp in zip(slots, resps):
                 out[i] = resp
+                # leases acquired over the peer lane attribute to the
+                # forwarding peer: its death releases them (health.py)
+                self._account_decision(requests[i], resp, client_id)
         return [o if o is not None else RateLimitResp() for o in out]
 
     async def update_peer_globals(self, globals_: Sequence) -> None:
@@ -660,9 +786,12 @@ class Instance:
         return await loop.run_in_executor(self.batcher._executor, fn)
 
     async def export_snapshot(self, layout: str = "auto", now=None):
-        """Quiesced device->host export (state/snapshot.ArenaSnapshot)."""
-        return await self._quiesced(
+        """Quiesced device->host export (state/snapshot.ArenaSnapshot).
+        The concurrency-lease book rides along (optional npz keys)."""
+        snap = await self._quiesced(
             lambda: self.engine.export_state(now=now, layout=layout))
+        snap.leases = self.leases.export_rows()
+        return snap
 
     async def save_snapshot(self, path: str, layout: str = "auto") -> int:
         """Export + atomic write; returns bytes written.  The quiesce pause
@@ -693,14 +822,15 @@ class Instance:
         snap = snapmod.loads(data)
         await self._quiesced(
             lambda: self.engine.import_state(snap, rebase_to=rebase_to))
+        if snap.leases:
+            self.leases.import_rows(snap.leases)
         return snap.total_keys()
 
     async def transfer_buckets(self, payload: bytes) -> bytes:
         """Dest side of live migration: import shipped rows, never
         clobbering a fresher local entry (engine.import_rows)."""
-        from gubernator_tpu.api.types import millisecond_now
         from gubernator_tpu.state import migrate
-        regular, global_ = migrate.decode_rows(payload)
+        regular, global_, leases = migrate.decode_rows(payload)
         now = millisecond_now()
         imp = sk = gimp = gsk = 0
         if regular:
@@ -709,6 +839,19 @@ class Instance:
         if global_:
             gimp, gsk = await self._quiesced(
                 lambda: self.engine.import_global_rows(global_, now=now))
+        if leases:
+            # re-register in-flight concurrency leases under the new owner
+            # (the device free-slot counters arrived with the arena rows)
+            self.leases.import_rows(
+                (r[0], r[1], r[2], r[3]) for r in leases)
+            for r in leases:
+                if len(r) >= 8 and r[4]:
+                    self._lease_tmpl[r[0]] = RateLimitReq(
+                        name=str(r[4]), unique_key=str(r[5]),
+                        limit=int(r[6]), duration=int(r[7]),
+                        algorithm=Algorithm.CONCURRENCY)
+            log.info("migration import: %d lease rows re-registered",
+                     len(leases))
         self.metrics.observe_migration(imported=imp + gimp,
                                        skipped_stale=sk + gsk)
         if imp or gimp or sk or gsk:
@@ -743,6 +886,14 @@ class Instance:
                 lambda ks=dkeys: self.engine.export_rows(ks))
             grows = await self._quiesced(
                 lambda ks=dgkeys: self.engine.export_global_rows(ks))
+            lrows = []
+            for key, client, count, expire in self.leases.export_rows(
+                    dkeys):
+                tmpl = self._lease_tmpl.get(key)
+                lrows.append([key, client, count, expire]
+                             + ([tmpl.name, tmpl.unique_key, tmpl.limit,
+                                 tmpl.duration] if tmpl is not None
+                                else ["", "", 0, 0]))
             peer = self._picker.get_by_host(dest)
             if peer is None:
                 log.warning("migration: new owner %s not connected; "
@@ -750,12 +901,13 @@ class Instance:
                             len(dkeys) + len(dgkeys))
                 continue
             ack = migrate.decode_ack(await peer.transfer_buckets(
-                migrate.encode_rows(rows, grows)))
+                migrate.encode_rows(rows, grows, lrows)))
             # moved regular keys leave the host table either way: the dest
             # is authoritative now (a stale skip means it was ALREADY
             # fresher), and routing no longer brings them here
             await self._quiesced(
                 lambda ks=dkeys: self.engine.remove_keys(ks))
+            self.leases.drop_keys(dkeys)
             totals["moved"] += len(dkeys)
             totals["gmoved"] += len(dgkeys)
             totals["imported"] += ack["imported"] + ack["gimported"]

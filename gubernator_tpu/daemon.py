@@ -51,6 +51,7 @@ class Daemon:
         self.pool = None
         self.monitor = None  # net/health.py HeartbeatMonitor (static pools)
         self._snapshot_task: Optional[asyncio.Task] = None
+        self._lease_sweep_task: Optional[asyncio.Task] = None
         # phase names appended as stop() executes them, in order — the
         # shutdown-ordering contract the signal-path tests assert
         self.shutdown_phases: list = []
@@ -74,6 +75,21 @@ class Daemon:
         while True:
             await asyncio.sleep(interval)
             await self._snapshot_once()
+
+    async def _lease_sweep_loop(self, interval_ms: int) -> None:
+        """Periodically drop expired grants from the concurrency-lease
+        book (GUBER_LEASE_SWEEP_MS).  The device buckets already expired,
+        so this only keeps the lease gauges and per-client holds honest."""
+        from gubernator_tpu.api.types import millisecond_now
+        while True:
+            await asyncio.sleep(interval_ms / 1000.0)
+            try:
+                dropped = self.instance.leases.sweep(millisecond_now())
+                if dropped:
+                    self.instance.metrics.observe_lease_release(
+                        "expired", sum(c for _, _, c in dropped))
+            except Exception:
+                log.exception("lease sweep failed")
 
     async def start(self) -> None:
         c = self.conf
@@ -147,14 +163,24 @@ class Daemon:
             _os.makedirs(c.snapshot_dir, exist_ok=True)
             from gubernator_tpu.state.snapshot import restore_engine
             loop = asyncio.get_running_loop()
-            await loop.run_in_executor(
+            snap = await loop.run_in_executor(
                 self.instance.batcher._executor,
                 lambda: restore_engine(self.instance.engine,
                                        self._snapshot_file(),
                                        metrics=self.instance.metrics))
+            if snap is not None and getattr(snap, "leases", None):
+                # re-register restored concurrency leases (the device
+                # free-slot counters came back with the arena planes)
+                self.instance.leases.import_rows(snap.leases)
             self._snapshot_task = asyncio.create_task(self._snapshot_loop())
             log.info("snapshots -> %s every %dms", c.snapshot_dir,
                      c.snapshot_interval_ms)
+
+        sweep_ms = getattr(getattr(c, "leases", None),
+                           "sweep_interval_ms", 0)
+        if sweep_ms > 0:
+            self._lease_sweep_task = asyncio.create_task(
+                self._lease_sweep_loop(sweep_ms))
 
         if c.frontdoor_workers > 0 and mesh_peers is None:
             # multi-process front door (frontdoor.py): N acceptor worker
@@ -352,6 +378,12 @@ class Daemon:
 
     async def _teardown(self) -> None:
         self._phase("teardown")
+        if self._lease_sweep_task is not None:
+            self._lease_sweep_task.cancel()
+            try:
+                await self._lease_sweep_task
+            except asyncio.CancelledError:
+                pass
         if self.pool is not None:
             await self.pool.close()
         if self.http is not None:

@@ -752,6 +752,29 @@ inline uint8_t* write_varint(uint8_t* p, uint64_t v) {
 constexpr int64_t COMPACT_MAX_HITS = 1ll << 28;
 constexpr int64_t COMPACT_MAX_LIMIT = 1ll << 31;
 constexpr int64_t COMPACT_MAX_DURATION = (1ll << 31) - 16;
+// Algorithm-plane caps (ops/kernel.py): sliding windows interpolate across
+// two buckets so the rebased-i32 proof needs now - window_start < 2*duration;
+// concurrency hits are sign-extended through bit 27 of the compact hits field
+// so releases (negative hits) survive the 28-bit encode.
+constexpr int64_t SLIDING_MAX_DURATION = 1ll << 30;
+constexpr int64_t CONC_MAX_HITS = 1ll << 27;
+
+// Per-algorithm compact range gate.  algo 0..4 are stageable; anything the
+// compact wire cannot carry exactly returns false and the caller falls back
+// to the full python path (-2).
+inline bool compact_ranges_ok(int64_t hits, int64_t limit, int64_t duration,
+                              int64_t algo) {
+  if (algo < 0 || algo > 4) return false;
+  if (algo == 4) {
+    if (hits <= -CONC_MAX_HITS || hits >= CONC_MAX_HITS) return false;
+  } else {
+    if (hits < 0 || hits >= COMPACT_MAX_HITS) return false;
+  }
+  if (limit < 0 || limit >= COMPACT_MAX_LIMIT) return false;
+  int64_t dcap = algo == 3 ? SLIDING_MAX_DURATION : COMPACT_MAX_DURATION;
+  if (duration < 0 || duration >= dcap) return false;
+  return true;
+}
 
 }  // namespace
 
@@ -958,7 +981,10 @@ inline void stage_lane(Router* r, int32_t shard, uint64_t fp,
   uint8_t is_init = 0;
   int32_t slot = shard_lookup(&r->shards[shard], fp, now, duration,
                               r->pack_seq, &is_init, key, key_len);
-  bool synth = hits == 1 && limit > 0;  // response synthesizable by pos
+  // response synthesizable by pos; algo >= 2 never aggregates (posinfo
+  // carries the algorithm in 2 bits only, and GCRA/sliding/concurrency
+  // responses are not linear in the fold count anyway)
+  bool synth = hits == 1 && limit > 0 && algo <= 1;
   // Probe the key's drain cell for BOTH synth and plain items: a plain
   // lane staged for this key must invalidate any armed aggregation lane
   // (folding a later item into a lane that sorts BEFORE the plain lane
@@ -990,8 +1016,13 @@ inline void stage_lane(Router* r, int32_t shard, uint64_t fp,
   if (is_init) push_commit(r, shard, slot);
   int64_t row = (int64_t)k * S + shard;
   int64_t o = (row * lanes + lane) * 2;
+  // algo rides in 3 bits: bit 33 plus bits 62..63, so legacy token/leaky
+  // words stay bit-identical; hits are masked because concurrency releases
+  // are negative (sign-extended from bit 27 on decode)
   int64_t w0 = (int64_t)(slot + 1) | ((int64_t)is_init << 32) |
-               ((int64_t)algo << 33) | (hits << 34);
+               ((int64_t)(algo & 1) << 33) |
+               ((hits & (COMPACT_MAX_HITS - 1)) << 34) |
+               ((int64_t)((algo >> 1) & 3) << 62);
   if (synth) {
     w0 |= AGG_W0_BIT;  // n=1 aggregate: device returns r_start
     out_pos[i] = 0 | ((int32_t)algo << 30);
@@ -1129,10 +1160,11 @@ int64_t fastpath_parse_stack(Router* r, const uint8_t* buf, int64_t len,
 
     if (it->name_len == 0 || it->key_len == 0) return -2;
     if (behavior != 0) return -2;  // BATCHING only
-    if (it->algo > 1) return -2;
-    if (it->hits < 0 || it->hits >= COMPACT_MAX_HITS) return -2;
-    if (it->limit < 0 || it->limit >= COMPACT_MAX_LIMIT) return -2;
-    if (it->duration < 0 || it->duration >= COMPACT_MAX_DURATION) return -2;
+    // concurrency rides the python path: the host lease book needs
+    // per-item visibility the bytes lane does not surface
+    if (it->algo == 4) return -2;
+    if (!compact_ranges_ok(it->hits, it->limit, it->duration, it->algo))
+      return -2;
 
     // hash key = name + "_" + unique_key (client.go:33-35), streamed
     uint8_t sep = '_';
@@ -1269,10 +1301,9 @@ int64_t frontdoor_parse_req(const uint8_t* buf, int64_t len,
 
     if (it.name_len == 0 || it.key_len == 0) return -2;
     if (behavior != 0) return -2;  // BATCHING only
-    if (it.algo > 1) return -2;
-    if (it.hits < 0 || it.hits >= COMPACT_MAX_HITS) return -2;
-    if (it.limit < 0 || it.limit >= COMPACT_MAX_LIMIT) return -2;
-    if (it.duration < 0 || it.duration >= COMPACT_MAX_DURATION) return -2;
+    if (it.algo == 4) return -2;  // python path (lease book visibility)
+    if (!compact_ranges_ok(it.hits, it.limit, it.duration, it.algo))
+      return -2;
 
     int64_t kl = it.name_len + 1 + it.key_len;
     if (koff + kl > key_cap) return -4;
@@ -1398,10 +1429,8 @@ int64_t router_pack_stack(Router* r, const uint8_t* key_bytes,
   static thread_local uint8_t bump2[MAX_STACK_ITEMS];
 
   for (int64_t i = 0; i < n; i++) {
-    if (hits[i] < 0 || hits[i] >= COMPACT_MAX_HITS) return -2;
-    if (limits[i] < 0 || limits[i] >= COMPACT_MAX_LIMIT) return -2;
-    if (durations[i] < 0 || durations[i] >= COMPACT_MAX_DURATION) return -2;
-    if (algos[i] < 0 || algos[i] > 1) return -2;
+    if (!compact_ranges_ok(hits[i], limits[i], durations[i], algos[i]))
+      return -2;
     int64_t beg = i == 0 ? 0 : key_ends[i - 1];
     int64_t len = key_ends[i] - beg;
     const uint8_t* key = key_bytes + beg;

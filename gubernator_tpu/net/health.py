@@ -163,6 +163,15 @@ class HeartbeatMonitor:
             breaker = qos.breakers.get(host)
             if breaker is not None:
                 breaker.trip()
+        # give back the concurrency slots the dead peer's clients hold —
+        # nobody is left on that side to send the releases
+        release = getattr(self.instance, "release_peer_leases", None)
+        if release is not None:
+            try:
+                await release(host)
+            except Exception as e:
+                log.error("lease release after '%s' went down failed: %s",
+                          host, e)
         try:
             await self.instance.rehome(self.membership(), direction="down")
         except Exception as e:

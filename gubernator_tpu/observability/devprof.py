@@ -508,7 +508,7 @@ class Devprof:
 
 
 def build_census_arms(k: int = 8):
-    """The five serving-arm programs the kernel census counts
+    """The serving-arm programs the kernel census counts
     (probe_census.py), as runnable specs over a tiny single-device probe
     engine: [{name, fn, args, windows, measure_fn}].  `fn` is what the
     census traces (identical numbers to the historical probe); the
@@ -564,8 +564,24 @@ def build_census_arms(k: int = 8):
                                                  True, geom)
     ten = np.zeros((k, s, b), np.int32)
 
+    # mixed-algorithm composed window: every wire algorithm (token, leaky,
+    # GCRA, sliding-window, concurrency) live in ONE packed window's lanes.
+    # The census is data-independent, so this arm traces the SAME program
+    # as composed_drain — which is the point the scoreboard makes: the
+    # algorithm plane rides the ladder as select-chain depth, not extra
+    # kernels.  The measured pass drives real mixed-algorithm lanes
+    # through all five transition ladders.
+    lane = np.arange(b, dtype=np.int64)
+    mix1 = kernel.encode_batch_host(
+        lane % eng.capacity_per_shard, np.ones(b, np.int64),
+        np.full(b, 100, np.int64), np.full(b, 60_000, np.int64),
+        lane % 5, np.zeros(b, np.int64))
+    packed_mix = np.broadcast_to(mix1, (k, s, b, 2)).copy()
+
     one = (st1, packed1, jnp.int64(t0))
     drain_args = (eng.state, eng.gstate, eng.gcfg, packed, gb, ga, upd, nows)
+    mix_args = (eng.state, eng.gstate, eng.gcfg, packed_mix, gb, ga, upd,
+                nows)
     an_args = drain_args + (eng._an_sketch, ten, jnp.int64(0))
     return [
         {"name": "int64_xla", "fn": xla64, "args": one, "windows": 1,
@@ -575,6 +591,8 @@ def build_census_arms(k: int = 8):
         {"name": "fused_window", "fn": fusedw, "args": one, "windows": 1,
          "measure_fn": fusedw_measure},
         {"name": "composed_drain", "fn": fdrain, "args": drain_args,
+         "windows": k, "measure_fn": fdrain},
+        {"name": "composed_mixed_algos", "fn": fdrain, "args": mix_args,
          "windows": k, "measure_fn": fdrain},
         {"name": "composed_analytics", "fn": fan, "args": an_args,
          "windows": k, "measure_fn": fan},
@@ -596,9 +614,15 @@ def measure_census_arms(arms=None, iters: int = 2,
     if table is None:
         table = KernelTable()
     measured: Dict[str, dict] = {}
+    # arms sharing one body (composed_drain / composed_mixed_algos differ
+    # only in data) share one jitted wrapper so the body compiles once
+    jits: Dict[int, object] = {}
     for spec in arms:
         name, windows = spec["name"], spec["windows"]
-        jf = jax.jit(spec.get("measure_fn") or spec["fn"])
+        fn = spec.get("measure_fn") or spec["fn"]
+        jf = jits.get(id(fn))
+        if jf is None:
+            jf = jits[id(fn)] = jax.jit(fn)
         out = jf(*spec["args"])
         jax.block_until_ready(out)
         tmp = tempfile.mkdtemp(prefix=f"guber-measure-{name}-")

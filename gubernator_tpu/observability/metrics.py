@@ -438,6 +438,40 @@ class Metrics:
             ["state"],
             registry=self.registry,
         )
+        # algorithm plane (gubernator_tpu/algorithms/): per-algorithm
+        # decision mix, and the host-side concurrency-lease book
+        self.algo_decisions = Counter(
+            "guber_tpu_decisions_total",
+            "Rate-limit decisions served, by algorithm "
+            "(token_bucket | leaky_bucket | gcra | sliding_window | "
+            "concurrency).",
+            ["algorithm"],
+            registry=self.registry,
+        )
+        self.lease_held = Gauge(
+            "guber_tpu_lease_held_slots",
+            "Concurrency-lease slots currently held across all keys "
+            "(host lease book; the device free-slot counters are the "
+            "admission truth).",
+            registry=self.registry,
+        )
+        self.lease_clients = Gauge(
+            "guber_tpu_lease_clients",
+            "Distinct clients holding at least one concurrency lease.",
+            registry=self.registry,
+        )
+        self.lease_keys = Gauge(
+            "guber_tpu_lease_keys",
+            "Distinct keys with at least one live concurrency lease.",
+            registry=self.registry,
+        )
+        self.lease_releases = Counter(
+            "guber_tpu_lease_releases_total",
+            "Lease slots released on behalf of clients, by reason "
+            "(explicit | stream_close | peer_down | expired).",
+            ["reason"],
+            registry=self.registry,
+        )
         # SLO burn-rate engine (observability/analytics.py SLOEngine)
         self.slo_burn_rate = Gauge(
             "guber_slo_burn_rate",
@@ -614,6 +648,25 @@ class Metrics:
                     last[label] = cur
 
         self.add_scrape_hook(refresh)
+
+    def watch_leases(self, book) -> None:
+        """Export the concurrency-lease book's occupancy at scrape time
+        from ONE book.stats() read (keys/clients/held move together)."""
+
+        def refresh():
+            keys, clients, held = book.stats()
+            self.lease_keys.set(keys)
+            self.lease_clients.set(clients)
+            self.lease_held.set(held)
+
+        self.add_scrape_hook(refresh)
+
+    def observe_algorithm(self, algorithm: str, n: int = 1) -> None:
+        self.algo_decisions.labels(algorithm=algorithm).inc(n)
+
+    def observe_lease_release(self, reason: str, n: int) -> None:
+        if n > 0:
+            self.lease_releases.labels(reason=reason).inc(n)
 
     def watch_qos(self, qos) -> None:
         """Export the QoS control state at scrape time: queue depth, the

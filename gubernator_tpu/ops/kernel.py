@@ -57,11 +57,36 @@ from jax import lax
 
 # Algorithm / status constants mirrored from the proto enums
 # (proto/gubernator.proto:56-61,126-129).  Kept as plain ints so they can be
-# used inside jit without host lookups.
+# used inside jit without host lookups.  Values 2..4 extend the wire enum
+# beyond the reference (gubernator_tpu/algorithms/): GCRA as TAT arithmetic
+# on the tstamp column, a weighted two-bucket sliding window packed into the
+# remaining column, and concurrency leases with acquire/release semantics
+# (negative hits releases held slots).  Any OTHER value degrades to token
+# bucket — the reference's unknown-algorithm fallback (algorithms.go:100-104).
 TOKEN_BUCKET = 0
 LEAKY_BUCKET = 1
+GCRA = 2
+SLIDING_WINDOW = 3
+CONCURRENCY = 4
 UNDER_LIMIT = 0
 OVER_LIMIT = 1
+
+# Sliding-window packing: the remaining column carries BOTH window counters
+# as cur | prev<<15, so sliding limits are clamped to 2^15-1 (documented
+# divergence: a sliding request with limit > 32767 is served against 32767;
+# the response's `limit` still echoes the stored config).  The interpolation
+# weight is quantized to 1/1024ths so prev*(weight) stays exact in int32.
+SLIDING_PACK_BITS = 15
+SLIDING_MAX_LIMIT = (1 << SLIDING_PACK_BITS) - 1
+SLIDING_WEIGHT_Q = 1024
+# Sliding rows need now - window_start < 2*duration to stay inside the
+# rebased-i32 exactness range of the compact serving path, so the compact
+# eligibility cap for sliding durations is half the generic cap.
+SLIDING_MAX_DURATION = 1 << 30
+
+# Concurrency hits travel sign-extended through the 28-bit compact hits
+# field (bit 27 is the sign), so releases are range-limited to |hits| < 2^27.
+CONC_MAX_HITS = 1 << 27
 
 # Slot value marking a padded (unused) lane of a window batch.
 PAD_SLOT = -1
@@ -168,6 +193,41 @@ def _chain(pairs, default):
     return out
 
 
+def _sliding_roll(R, T, D, L, now):
+    """Advance a sliding-window register to the window containing `now`.
+
+    Returns (prev1, cur1, ws1, est, sl_L): the rolled previous/current
+    counters, the rolled window start, the weighted estimate the admission
+    check runs against, and the clamped effective limit.  Shared verbatim
+    by transition's hit ladder and fold_entering's prefix fold so the two
+    cannot drift (the roll depends only on (register, now), which is fixed
+    per window — that is what makes the sliding fold replay-free).
+
+    Exactness across the int64 / rebased-int32 lowerings: k*maxD <= now-T
+    and off is clipped into [0, maxD] BEFORE the weight multiply, so every
+    product stays below 2^25 and no intermediate can wrap in int32."""
+    dt = R.dtype
+    Z = jnp.asarray(0, dt)
+    ONE = jnp.asarray(1, dt)
+    Q = jnp.asarray(SLIDING_WEIGHT_Q, dt)
+    PMASK = jnp.asarray(SLIDING_MAX_LIMIT, dt)
+    sl_L = jnp.minimum(L, jnp.asarray(SLIDING_MAX_LIMIT, dt))
+    cur = R & PMASK
+    prev = (R >> SLIDING_PACK_BITS) & PMASK
+    maxD = jnp.maximum(D, ONE)
+    k = jnp.maximum((now - T) // maxD, Z)
+    prev1 = _chain([(k == Z, prev), (k == ONE, cur)], Z)
+    cur1 = jnp.where(k == Z, cur, Z)
+    ws1 = T + k * maxD
+    offc = jnp.clip(now - ws1, Z, maxD)
+    pos_q = jnp.where(maxD <= Q,
+                      (offc * Q) // maxD,
+                      jnp.minimum(offc // jnp.maximum(maxD // Q, ONE), Q))
+    pos_q = jnp.clip(pos_q, Z, Q)
+    weighted = (prev1 * (Q - pos_q)) // Q
+    return prev1, cur1, ws1, weighted + cur1, sl_L
+
+
 def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh,
                agg=None):
     """One request applied to one bucket, vectorized over the batch dimension.
@@ -188,6 +248,10 @@ def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh,
     L, D, R, T, E, A = reg
     h = hits
     is_token = req_algo == TOKEN_BUCKET
+    is_leaky = req_algo == LEAKY_BUCKET
+    is_gcra = req_algo == GCRA
+    is_sliding = req_algo == SLIDING_WINDOW
+    is_conc = req_algo == CONCURRENCY
     # counter dtype follows the inputs: i64 normally; the Pallas TPU path
     # runs the same ladder in rebased i32 (Mosaic has no 64-bit vectors,
     # and the compact-format range caps make i32 exact — see
@@ -196,16 +260,37 @@ def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh,
     ONE = jnp.asarray(1, h.dtype)
 
     # ---- init path (cache miss): algorithms.go:68-84 / :161-185 ----
-    over_init = h > req_limit
-    init_R = jnp.where(over_init, Z, req_limit - h)
+    # Per-algorithm only where the stored shape demands it; every init
+    # default is the token image, so out-of-range algorithm values
+    # degrade to token bucket here too (algorithms.go:100-104).
+    # GCRA's emission interval, same stored-duration/request-limit quirk
+    # as leaky's rate and clamped the same way.
+    rate_q = jnp.maximum(req_duration // jnp.maximum(req_limit, ONE), ONE)
+    sl_l0 = jnp.minimum(req_limit, jnp.asarray(SLIDING_MAX_LIMIT, h.dtype))
+    eff_init_limit = jnp.where(is_sliding, sl_l0, req_limit)
+    conc_rel0 = is_conc & (h < Z)  # release with nothing held: full bucket
+    over_init = (h > eff_init_limit) & ~conc_rel0
+    init_R = _chain([(conc_rel0, eff_init_limit), (over_init, Z)],
+                    eff_init_limit - h)
     init_status = jnp.where(over_init, OVER_LIMIT, UNDER_LIMIT).astype(I32)
     # token stores reset_time = now+duration (:69-74); leaky stores
-    # TimeStamp = now (:166) and its init response has ResetTime 0 (:173).
-    init_T = jnp.where(is_token, now + req_duration, now)
+    # TimeStamp = now (:166) and its init response has ResetTime 0 (:173);
+    # GCRA stores the theoretical-arrival-time (saturated to now+duration
+    # on an over-ask so the burst refills at `rate_q`); sliding stores the
+    # window start; concurrency stamps the last-touch time.
+    init_T = _chain(
+        [(is_leaky | is_sliding | is_conc, now),
+         (is_gcra, jnp.where(over_init, now + req_duration,
+                             now + h * rate_q))],
+        now + req_duration)
+    # sliding packs cur into the remaining column (prev == 0 at init);
+    # an over-ask saturates the window so reads stay OVER until it rolls
+    init_R_store = jnp.where(
+        is_sliding, jnp.where(over_init, sl_l0, jnp.maximum(h, Z)), init_R)
     init_reg = _Reg(
         limit=req_limit,
         duration=req_duration,
-        remaining=init_R,
+        remaining=init_R_store,
         tstamp=init_T,
         expire=now + req_duration,
         algo=req_algo,
@@ -214,7 +299,12 @@ def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh,
         status=init_status,
         limit=req_limit,
         remaining=init_R,
-        reset_time=jnp.where(is_token, now + req_duration, Z),
+        reset_time=_chain(
+            [(is_leaky | is_conc, Z),
+             (is_gcra, jnp.where(over_init, now + rate_q,
+                                 now + h * rate_q)),
+             (is_sliding, now + req_duration)],
+            now + req_duration),
     )
 
     # ---- token bucket hit path: algorithms.go:40-65 ----
@@ -275,10 +365,117 @@ def transition(reg: _Reg, hits, req_limit, req_duration, req_algo, now, fresh,
     leaky_reg = _Reg(limit=L, duration=D, remaining=l_new_R, tstamp=T2, expire=l_new_E, algo=A)
     leaky_out = WindowOutput(status=l_status, limit=L, remaining=l_resp_R, reset_time=l_reset)
 
+    # ---- GCRA hit path: TAT arithmetic on the tstamp column ----
+    # rate reuses leaky's stored-duration // request-limit emission
+    # interval (computed above).  base = max(TAT, now); the burst
+    # capacity is how many emission intervals fit between base and the
+    # horizon now+D, clamped to the stored limit.  Consuming h advances
+    # the TAT by h*rate; rejected and read lanes never mutate (the
+    # no-mutation-on-over-ask contract carried over from token).
+    g_base = jnp.maximum(T, now)
+    g_raw = jnp.maximum((now + D - g_base) // rate, Z)
+    g_cap = jnp.minimum(g_raw, L)
+    g_at_zero = g_cap == 0
+    g_read = h == 0
+    g_drain = h == g_cap
+    g_over = h > g_cap
+    g_status = _chain(
+        [(g_at_zero, OVER_LIMIT), (g_read, UNDER_LIMIT),
+         (g_drain, UNDER_LIMIT), (g_over, OVER_LIMIT)],
+        UNDER_LIMIT,
+    ).astype(I32)
+    g_resp_R = _chain(
+        [(g_at_zero, Z), (g_read, g_cap), (g_drain, Z), (g_over, g_cap)],
+        g_cap - h,
+    )
+    g_consume = ~(g_at_zero | g_read | g_over)
+    g_new_T = jnp.where(g_consume, g_base + h * rate, T)
+    g_reset = _chain(
+        [(g_at_zero, now + rate), (g_read, g_base), (g_over, now + rate)],
+        g_new_T,
+    )
+    gcra_reg = _Reg(limit=L, duration=D, remaining=R, tstamp=g_new_T,
+                    expire=E, algo=A)
+    gcra_out = WindowOutput(status=g_status, limit=L, remaining=g_resp_R,
+                            reset_time=g_reset)
+
+    # ---- sliding-window hit path: weighted two-bucket interpolation ----
+    # The register rolls to the window containing `now` on EVERY branch
+    # (like leaky's leak, the roll commits even on reads/rejects — it is
+    # idempotent, which is what keeps the prefix fold replay-free); only
+    # an accepted request adds to the current counter and re-arms expiry.
+    sl_prev1, sl_cur1, sl_ws, sl_est, sl_L = _sliding_roll(R, T, D, L, now)
+    sl_full = sl_est >= sl_L
+    sl_read = h == 0
+    sl_over = sl_est + h > sl_L
+    sl_status = _chain(
+        [(sl_full, OVER_LIMIT), (sl_read, UNDER_LIMIT),
+         (sl_over, OVER_LIMIT)],
+        UNDER_LIMIT,
+    ).astype(I32)
+    sl_resp_R = _chain(
+        [(sl_full, Z), (sl_read, sl_L - sl_est), (sl_over, sl_L - sl_est)],
+        sl_L - sl_est - h,
+    )
+    sl_accept = ~(sl_full | sl_read | sl_over)
+    sl_cur2 = jnp.where(sl_accept, sl_cur1 + h, sl_cur1)
+    sl_new_R = sl_cur2 | (sl_prev1 << SLIDING_PACK_BITS)
+    sl_new_E = jnp.where(sl_accept, now + req_duration, E)
+    sliding_reg = _Reg(limit=L, duration=D, remaining=sl_new_R,
+                       tstamp=sl_ws, expire=sl_new_E, algo=A)
+    sliding_out = WindowOutput(
+        status=sl_status, limit=L, remaining=sl_resp_R,
+        reset_time=sl_ws + jnp.maximum(D, ONE))
+
+    # ---- concurrency hit path: acquire/release over live leases ----
+    # remaining counts FREE slots; positive hits acquires (token ladder),
+    # negative hits releases (saturating add back toward the stored
+    # limit, always UNDER).  reset_time is always the 0 sentinel — a
+    # lease has no time-based reset; expiry re-arms on every mutation so
+    # held leases keep the bucket (and the host lease book) alive.
+    c_rel = h < Z
+    c_at_zero = R == 0
+    c_read = h == 0
+    c_over = h > R
+    # saturating release written add-after-min (leaky's R2 trick) so the
+    # i32 lowering cannot overflow on R - h
+    c_rel_R = R + jnp.minimum(-h, L - R)
+    c_status = _chain(
+        [(c_rel, UNDER_LIMIT), (c_at_zero, OVER_LIMIT),
+         (c_read, UNDER_LIMIT), (c_over, OVER_LIMIT)],
+        UNDER_LIMIT,
+    ).astype(I32)
+    c_resp_R = _chain(
+        [(c_rel, c_rel_R), (c_at_zero, Z), (c_read, R), (c_over, R)],
+        R - h,
+    )
+    c_new_R = _chain(
+        [(c_rel, c_rel_R), (c_at_zero, R), (c_read, R), (c_over, R)],
+        R - h,
+    )
+    c_mut = c_rel | ~(c_at_zero | c_read | c_over)
+    conc_reg = _Reg(limit=L, duration=D, remaining=c_new_R,
+                    tstamp=jnp.where(c_mut, now, T),
+                    expire=jnp.where(c_mut, now + req_duration, E),
+                    algo=A)
+    conc_out = WindowOutput(status=c_status, limit=L, remaining=c_resp_R,
+                            reset_time=jnp.zeros_like(T))
+
     # ---- combine: requested algorithm picks the hit path (non-fresh lanes
-    # are guaranteed to have stored algo == requested algo) ----
-    hit_reg = jax.tree.map(lambda t, l: jnp.where(is_token, t, l), token_reg, leaky_reg)
-    hit_out = jax.tree.map(lambda t, l: jnp.where(is_token, t, l), token_out, leaky_out)
+    # are guaranteed to have stored algo == requested algo).  First-match
+    # select chain over all five values with token as the DEFAULT, so an
+    # out-of-range algorithm degrades to token bucket exactly like the
+    # reference's fallback (algorithms.go:100-104). ----
+    hit_reg, hit_out = token_reg, token_out
+    for sel, breg, bout in (
+            (is_leaky, leaky_reg, leaky_out),
+            (is_gcra, gcra_reg, gcra_out),
+            (is_sliding, sliding_reg, sliding_out),
+            (is_conc, conc_reg, conc_out)):
+        hit_reg = _Reg(*jax.tree.map(
+            lambda b, t, s=sel: jnp.where(s, b, t), breg, hit_reg))
+        hit_out = WindowOutput(*jax.tree.map(
+            lambda b, t, s=sel: jnp.where(s, b, t), bout, hit_out))
 
     new_reg = jax.tree.map(lambda i, hh: jnp.where(fresh, i, hh), init_reg, hit_reg)
     out = jax.tree.map(lambda i, hh: jnp.where(fresh, i, hh), init_out, hit_out)
@@ -373,7 +570,10 @@ def fold_entering(reg: _Reg, fresh0, h0, l0, d0, a0, pos, nz, n_lead,
     dt = hstar.dtype
     Z = jnp.asarray(0, dt)
     ONE = jnp.asarray(1, dt)
-    is_tok = a0 == TOKEN_BUCKET
+    is_lky = a0 == LEAKY_BUCKET
+    is_gc = a0 == GCRA
+    is_sl = a0 == SLIDING_WINDOW
+    is_cc = a0 == CONCURRENCY
     # init path image: over-limit init stores a drained balance
     over0 = fresh0 & (h0 > l0)
     L_eff = jnp.where(fresh0, l0, reg.limit)
@@ -415,12 +615,73 @@ def fold_entering(reg: _Reg, fresh0, h0, l0, d0, a0, pos, nz, n_lead,
     entR_lky = jnp.where(phaseA, satA(posd), Rh - hstar * kl)
     T_lky = jnp.where(fresh0 | (nz > 0), now, reg.tstamp)
     E_lky = jnp.where(fresh0 | (gen >= ONE), now + d0, reg.expire)
+
+    # ---- GCRA: token-shaped fold on the TAT-derived burst capacity ----
+    # The capacity raw = (now+D-base)//rate drops by EXACTLY hstar per
+    # accept (subtracting an exact multiple of rate commutes with the
+    # floor division), so the accept count is the same greedy min as
+    # token's, gated on hstar <= L (the per-hit clamp to the stored
+    # limit).  Only the TAT evolves; reads and rejects freeze it, so a
+    # kp == 0 non-fresh lane must see the RAW stored tstamp.
+    g_rate0 = rate0
+    g_base_nf = jnp.maximum(reg.tstamp, now)
+    g_rawNF = jnp.maximum((now + D_eff - g_base_nf) // g_rate0, Z)
+    g_rawT = jnp.where(fresh0, jnp.where(over0, Z, D_eff // g_rate0),
+                       g_rawNF)
+    g_kp = jnp.where((hstar > Z) & (hstar <= L_eff),
+                     jnp.minimum(nzd, g_rawT // jnp.maximum(hstar, ONE)),
+                     Z)
+    g_baset = jnp.where(fresh0,
+                        jnp.where(over0, now + d0, now), g_base_nf)
+    entT_gc = jnp.where((g_kp > Z) | fresh0,
+                        g_baset + g_kp * hstar * g_rate0, reg.tstamp)
+    entR_gc = jnp.where(fresh0, jnp.where(over0, Z, l0 - h0),
+                        reg.remaining)
+
+    # ---- sliding: the roll happens once (now is fixed per window) and
+    # every accept adds hstar to the estimate, so the accept count is the
+    # token greedy min over the post-roll headroom ----
+    s_prev1, s_cur1, s_ws1, s_est0, s_L = _sliding_roll(
+        reg.remaining, reg.tstamp, D_eff, L_eff, now)
+    s_over0 = fresh0 & (h0 > s_L)
+    s_est_base = jnp.where(fresh0, jnp.where(s_over0, s_L, Z), s_est0)
+    s_kp = jnp.where(hstar > Z,
+                     jnp.minimum(nzd, jnp.maximum(s_L - s_est_base, Z)
+                                 // jnp.maximum(hstar, ONE)),
+                     Z)
+    s_cur_ent = (jnp.where(fresh0, jnp.where(s_over0, s_L, Z), s_cur1)
+                 + s_kp * hstar)
+    s_prev_ent = jnp.where(fresh0, Z, s_prev1)
+    entR_sl = s_cur_ent | (s_prev_ent << SLIDING_PACK_BITS)
+    entT_sl = jnp.where(fresh0, now, s_ws1)
+    E_sl = jnp.where(fresh0 | (s_kp >= ONE), now + d0, reg.expire)
+
+    # ---- concurrency: acquires fold exactly like token; releases are a
+    # saturating climb toward the stored limit (monotone, so the k-th
+    # release's balance is closed-form via the saturation point) ----
+    c_a = -hstar  # release magnitude (valid when hstar < 0)
+    c_R0 = reg.remaining
+    c_gap = L_eff - c_R0
+    c_ksat = jnp.where(c_gap > Z,
+                       (c_gap + c_a - ONE) // jnp.maximum(c_a, ONE), Z)
+    entR_rel = jnp.where(
+        fresh0, l0,
+        jnp.where(nzd == Z, c_R0,
+                  jnp.where(nzd >= c_ksat, L_eff, c_R0 + nzd * c_a)))
+    entR_cc = jnp.where(hstar < Z, entR_rel, entR_tok)
+    c_applied = jnp.where(hstar < Z, nzd, kt)
+    T_cc = jnp.where(fresh0 | (c_applied >= ONE), now, reg.tstamp)
+    E_cc = jnp.where(fresh0 | (c_applied >= ONE), now + d0, reg.expire)
+
+    # default = token, matching transition's out-of-range fallback
+    pick = lambda lk, gc, sl, cc, tok: _chain(  # noqa: E731
+        [(is_lky, lk), (is_gc, gc), (is_sl, sl), (is_cc, cc)], tok)
     return _Reg(
         limit=L_eff,
         duration=D_eff,
-        remaining=jnp.where(is_tok, entR_tok, entR_lky),
-        tstamp=jnp.where(is_tok, T_tok, T_lky),
-        expire=jnp.where(is_tok, E_tok, E_lky),
+        remaining=pick(entR_lky, entR_gc, entR_sl, entR_cc, entR_tok),
+        tstamp=pick(T_lky, entT_gc, entT_sl, T_cc, T_tok),
+        expire=pick(E_lky, E_tok, E_sl, E_cc, E_tok),
         algo=a0,
     )
 
@@ -533,10 +794,14 @@ def fold_classify(s_hits, s_limit, s_duration, s_algo, s_agg,
     rate0 = jnp.maximum(jnp.where(fresh0, d0, reg.duration)
                         // jnp.maximum(l0, ONE), ONE)
     leak0 = jnp.where(fresh0, Z, (now - reg.tstamp) // rate0)
-    lky_ok = ((a0 == TOKEN_BUCKET) | fresh0
+    lky_ok = ((a0 != LEAKY_BUCKET) | fresh0
               | ((reg.remaining <= L_eff)
                  & ((leak0 >= Z) | (n_lead == 0))))
-    seg_fold = cfg_ok & (hstar >= Z) & lky_ok
+    # negative hits (concurrency releases) fold — the saturating climb is
+    # closed-form; a negative hstar under any OTHER algorithm is an
+    # engine-rejected shape and replays (exact by construction)
+    hstar_ok = (hstar >= Z) | (a0 == CONCURRENCY)
+    seg_fold = cfg_ok & hstar_ok & lky_ok
     return seg_fold, nz, n_lead, hstar
 
 
@@ -875,7 +1140,11 @@ def split_outputs(fused, lanes: int) -> tuple[WindowOutput, WindowOutput]:
 #
 #   request  i64[B, 2]:
 #     w0: bits 0..31 slot+1 (0 = padded lane), bit 32 is_init,
-#         bit 33 algorithm, bits 34..61 hits
+#         bit 33 algorithm bit 0, bits 34..61 hits,
+#         bits 62..63 algorithm bits 1..2 (zero for token/leaky, so the
+#         pre-algorithm-plane encoding is bit-identical for algo 0/1;
+#         concurrency hits are SIGN-EXTENDED from bit 27 of the hits
+#         field, so releases travel as |hits| < 2^27)
 #     w1: bits 0..31 limit, bits 32..62 duration
 #   response i64[B, 2]:
 #     w0: bits 0..30 remaining, bit 31 status,
@@ -901,12 +1170,17 @@ def decode_batch(packed) -> WindowBatch:
     """Device-side decode of the compact request pair (see layout above)."""
     w0 = packed[..., 0]
     w1 = packed[..., 1]
+    algo = (((w0 >> 33) & 1) | (((w0 >> 62) & 3) << 1)).astype(I32)
+    hits_raw = (w0 >> 34) & (COMPACT_MAX_HITS - 1)
+    # concurrency releases: hits sign-extend from bit 27
+    hits = jnp.where(algo == CONCURRENCY,
+                     (hits_raw ^ CONC_MAX_HITS) - CONC_MAX_HITS, hits_raw)
     return WindowBatch(
         slot=(w0 & 0xFFFFFFFF).astype(I32) - 1,
-        hits=(w0 >> 34) & (COMPACT_MAX_HITS - 1),
+        hits=hits,
         limit=w1 & 0xFFFFFFFF,
         duration=(w1 >> 32) & 0x7FFFFFFF,
-        algo=((w0 >> 33) & 1).astype(I32),
+        algo=algo,
         is_init=((w0 >> 32) & 1).astype(jnp.bool_),
     )
 
@@ -919,10 +1193,12 @@ def encode_batch_host(slot, hits, limit, duration, algo, is_init):
     import numpy as np
 
     pad = slot < 0
+    a64 = algo.astype(np.int64)
     w0 = ((slot.astype(np.int64) + 1)
           | (is_init.astype(np.int64) << 32)
-          | (algo.astype(np.int64) << 33)
-          | (hits << 34))
+          | ((a64 & 1) << 33)
+          | ((hits & (COMPACT_MAX_HITS - 1)) << 34)
+          | (((a64 >> 1) & 3) << 62))
     w0 = np.where(pad, 0, w0)
     w1 = limit | (duration << 32)
     return np.stack([w0, w1], axis=-1)

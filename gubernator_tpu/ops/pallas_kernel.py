@@ -333,12 +333,14 @@ def window_step_pallas(state: BucketState, batch: WindowBatch, now, *,
             s_agg, pos, seg_len, seg_start_idx, seg_fold,
             k_h0, k_l0, k_d0, a0, fresh_seg, k_cur, nz, n_lead, k_hstar)
     if compact32:
-        # re-absolutize.  reset_time: leaky uses 0 as the "no reset"
-        # sentinel and every leaky non-zero reset is now+rate with
-        # rate >= 1, so rel == 0 distinguishes exactly; token lanes always
+        # re-absolutize.  reset_time: leaky and concurrency use 0 as the
+        # "no reset" sentinel (leaky's non-zero resets are now+rate with
+        # rate >= 1; concurrency resets are ALWAYS the sentinel), so
+        # rel == 0 distinguishes exactly; token/GCRA/sliding lanes always
         # carry a real time (rel 0 == "resets at now") and never the
         # sentinel (algorithms.go:130-141 vs :69-74).
-        leaky_lane = s_algo == kernel.LEAKY_BUCKET
+        leaky_lane = ((s_algo == kernel.LEAKY_BUCKET)
+                      | (s_algo == kernel.CONCURRENCY))
         reset64 = jnp.where(
             leaky_lane & (out_sorted.reset_time == 0), jnp.int64(0),
             out_sorted.reset_time.astype(I64) + now)
@@ -653,10 +655,16 @@ def _fused_window_body(n_lo, n_hi, req, arena):
     # (bit 32 group of the i64 word lands in the hi half's low bits; the
     # hits mask clears the arithmetic-shift sign smear)
     slot_raw = w0lo - 1
-    hits = (w0hi >> 2) & jnp.int32(kernel.COMPACT_MAX_HITS - 1)
+    hits_raw = (w0hi >> 2) & jnp.int32(kernel.COMPACT_MAX_HITS - 1)
     limit = w1lo
     duration = w1hi & jnp.int32(0x7FFFFFFF)
-    algo = (w0hi >> 1) & 1
+    # 3-bit algorithm: i64 bit 33 -> hi bit 1, i64 bits 62..63 -> hi bits
+    # 30..31 (the & 3 masks the arithmetic-shift sign smear)
+    algo = ((w0hi >> 1) & 1) | (((w0hi >> 30) & 3) << 1)
+    # concurrency releases: hits sign-extend from bit 27 (kernel.decode_batch)
+    conc = jnp.int32(kernel.CONC_MAX_HITS)
+    hits = jnp.where(algo == kernel.CONCURRENCY,
+                     (hits_raw ^ conc) - conc, hits_raw)
     is_init = (w0hi & 1) == 1
 
     # ---- window_prep in sorted, rebased-i32 form ----
@@ -743,7 +751,10 @@ def _fused_window_body(n_lo, n_hi, req, arena):
     # sentinel (rel == 0 on a leaky lane) or an absolute time that lands
     # exactly on zero; otherwise clip(rel, 0, 2^31-2) + 1, exact because
     # reset64 - now == rel in int64
-    leaky0 = (s_algo == kernel.LEAKY_BUCKET) & (out_sorted.reset_time == 0)
+    # leaky AND concurrency use reset 0 as the no-reset sentinel
+    leaky0 = (((s_algo == kernel.LEAKY_BUCKET)
+               | (s_algo == kernel.CONCURRENCY))
+              & (out_sorted.reset_time == 0))
     ab_lo, ab_hi = _pair_reabs(out_sorted.reset_time, n_lo, n_hi)
     reset_zero = leaky0 | ((ab_lo == 0) & (ab_hi == 0))
     enc = jnp.where(reset_zero, jnp.int32(0),

@@ -14,6 +14,7 @@ in both serving modes by construction, not by parallel implementation.
 
 from __future__ import annotations
 
+import asyncio
 import time
 from typing import Optional
 
@@ -21,6 +22,7 @@ import grpc
 
 from gubernator_tpu.api import pb
 from gubernator_tpu.api.grpc_api import add_peers_servicer, add_v1_servicer
+from gubernator_tpu.api.types import Algorithm as _Algorithm
 from gubernator_tpu.core.service import BatchTooLargeError, Instance
 from gubernator_tpu.observability.tracing import TRACEPARENT
 
@@ -30,6 +32,58 @@ from gubernator_tpu.observability.tracing import TRACEPARENT
 # BATCHING default, peers.go:143-172).  ~32B/item on the wire, so this is
 # roughly a 64-item batch.
 FASTPATH_MIN_BYTES = 2048
+
+
+def _client_id_from(context) -> Optional[str]:
+    """Caller identity for the concurrency-lease book: the transport-level
+    source ADDRESS (ports are ephemeral per connection, so identity sticks
+    across reconnects; a forwarding peer's grants attribute to its host)."""
+    peer = getattr(context, "peer", None)
+    if not callable(peer):
+        return None
+    try:
+        p = peer()
+    except Exception:
+        return None
+    if not p:
+        return None
+    if p.startswith(("ipv4:", "ipv6:")):
+        p = p.split(":", 1)[1].rsplit(":", 1)[0]
+    return p or None
+
+
+def _arm_lease_stream_close(inst: Instance, context,
+                            client_id: Optional[str]) -> None:
+    """Release a client's concurrency leases when its RPC is torn down
+    before the response is delivered (gRPC cancel = the stream closed
+    under us): the grants this RPC made never reached the holder, and a
+    vanished holder cannot release them itself."""
+    if client_id is None:
+        return
+    lease_conf = getattr(inst.conf, "leases", None)
+    if lease_conf is not None and not lease_conf.release_on_stream_close:
+        return
+    add_cb = getattr(context, "add_done_callback", None)
+    if not callable(add_cb):
+        return
+    loop = asyncio.get_running_loop()
+
+    def _on_done(ctx, cid=client_id, loop=loop):
+        cancelled = getattr(ctx, "cancelled", None)
+        try:
+            was = cancelled() if callable(cancelled) else False
+        except Exception:
+            was = False
+        if was and inst.leases.holds(cid):
+            loop.call_soon_threadsafe(
+                lambda: loop.create_task(
+                    inst.release_client_leases(cid,
+                                               reason="stream_close")))
+
+    try:
+        add_cb(_on_done)
+    except Exception:
+        pass
 
 
 def _traceparent_from(context) -> Optional[str]:
@@ -99,10 +153,13 @@ async def serve_get_rate_limits_inner(inst: Instance, data: bytes, context):
         if callable(tr):
             remaining = tr()
         deadline = inst.qos.deadline_from_timeout(remaining)
+    reqs = [pb.req_from_pb(r) for r in request.requests]
+    client_id = _client_id_from(context)
+    if any(r.algorithm == _Algorithm.CONCURRENCY for r in reqs):
+        _arm_lease_stream_close(inst, context, client_id)
     try:
         resps = await inst.get_rate_limits(
-            [pb.req_from_pb(r) for r in request.requests],
-            deadline=deadline)
+            reqs, deadline=deadline, client_id=client_id)
     except BatchTooLargeError as e:
         m.observe_rpc("/pb.gubernator.V1/GetRateLimits", start, ok=False)
         await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
@@ -133,7 +190,8 @@ async def serve_peer_rate_limits(inst: Instance, data: bytes,
                             "malformed GetPeerRateLimitsReq")
     try:
         resps = await inst.get_peer_rate_limits(
-            [pb.req_from_pb(r) for r in request.requests])
+            [pb.req_from_pb(r) for r in request.requests],
+            client_id=_client_id_from(context))
     except BatchTooLargeError as e:
         m.observe_rpc("/pb.gubernator.PeersV1/GetPeerRateLimits", start,
                       ok=False)

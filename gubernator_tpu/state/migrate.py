@@ -74,15 +74,24 @@ def ownership_diff(keys: Sequence[str], old_hosts: Iterable[str],
 # -------------------------------------------------------------- wire codec
 
 
-def encode_rows(regular: Sequence[dict], global_: Sequence[dict]) -> bytes:
-    return json.dumps({
+def encode_rows(regular: Sequence[dict], global_: Sequence[dict],
+                leases: Sequence[Sequence] = ()) -> bytes:
+    """`leases`: concurrency-lease book rows riding along with their keys,
+    [key, client, count, expire, name, unique_key, limit, duration] (the
+    last four may be empty/zero when the source lost the request template).
+    The key is OPTIONAL on the wire — old importers ignore it, old exporters
+    simply never send it — so the wire version stays 1."""
+    msg = {
         "v": WIRE_VERSION,
         "regular": [[r[f] for f in _ROW_FIELDS] for r in regular],
         "global": [[r[f] for f in _GROW_FIELDS] for r in global_],
-    }).encode("utf-8")
+    }
+    if leases:
+        msg["leases"] = [list(row) for row in leases]
+    return json.dumps(msg).encode("utf-8")
 
 
-def decode_rows(data: bytes) -> Tuple[List[dict], List[dict]]:
+def decode_rows(data: bytes) -> Tuple[List[dict], List[dict], List[list]]:
     try:
         msg = json.loads(data.decode("utf-8"))
         if msg["v"] != WIRE_VERSION:
@@ -90,6 +99,7 @@ def decode_rows(data: bytes) -> Tuple[List[dict], List[dict]]:
                 f"unsupported transfer wire version {msg['v']}")
         regular = [dict(zip(_ROW_FIELDS, r)) for r in msg["regular"]]
         global_ = [dict(zip(_GROW_FIELDS, r)) for r in msg["global"]]
+        leases = [list(r) for r in msg.get("leases", ())]
     except MigrationError:
         raise
     except Exception as e:
@@ -99,7 +109,13 @@ def decode_rows(data: bytes) -> Tuple[List[dict], List[dict]]:
             if not isinstance(r["key"], str) or any(
                     not isinstance(r[f], int) for f in fields[1:]):
                 raise MigrationError("malformed transfer row")
-    return regular, global_
+    for row in leases:
+        if (len(row) < 4 or not isinstance(row[0], str)
+                or not isinstance(row[1], str)
+                or not isinstance(row[2], int)
+                or not isinstance(row[3], int)):
+            raise MigrationError("malformed transfer lease row")
+    return regular, global_, leases
 
 
 def encode_ack(imported: int, skipped: int, gimported: int,

@@ -66,6 +66,11 @@ DEAD_REL = -(2 ** 31)
 _REG_PLANES = ("limit", "duration", "remaining", "tstamp", "expire", "algo")
 _CFG_PLANES = ("limit", "duration", "algo")
 
+# Top of the known algorithm alphabet (api/types.py Algorithm.CONCURRENCY).
+# Restored rows above this were written by a newer build whose packed-column
+# semantics this one cannot interpret — see _drop_unknown_algorithm_rows.
+_MAX_ALGO = 4
+
 
 class SnapshotError(Exception):
     """Unusable snapshot: bad magic/version/checksum, truncated payload, or
@@ -104,6 +109,10 @@ class ArenaSnapshot:
     # the wire — version-1 readers that predate tiers simply ignore them,
     # and their absence restores as an empty warm store (no version bump).
     warm: Optional[tuple] = None
+    # concurrency-lease book rows (algorithms/leases.py export_rows):
+    # [(key, client, count, expire)].  Same optional-npz-key pattern as
+    # `warm` — absent restores as an empty book, no version bump.
+    leases: List[tuple] = field(default_factory=list)
 
     def total_keys(self) -> int:
         reg = (sum(len(t[1]) for t in self.native_tables)
@@ -271,6 +280,16 @@ def dumps(snap: ArenaSnapshot) -> bytes:
         arrays["warm_ends"] = ends
         for name in _REG_PLANES:
             arrays[f"warm_{name}"] = np.asarray(wcols[name], np.int64)
+    if snap.leases:
+        lkeys, lclients, lcount, lexpire = zip(*snap.leases)
+        blob, ends = _pack_keys(list(lkeys))
+        arrays["lease_keys"] = blob
+        arrays["lease_ends"] = ends
+        cblob, cends = _pack_keys(list(lclients))
+        arrays["lease_clients"] = cblob
+        arrays["lease_cends"] = cends
+        arrays["lease_count"] = np.asarray(lcount, np.int64)
+        arrays["lease_expire"] = np.asarray(lexpire, np.int64)
 
     meta = {
         "now": snap.now,
@@ -352,10 +371,18 @@ def loads(data: bytes) -> ArenaSnapshot:
             warm = (_unpack_keys(arrays["warm_keys"], arrays["warm_ends"]),
                     {name: arrays[f"warm_{name}"].astype(np.int64)
                      for name in _REG_PLANES})
+        leases = []
+        if "lease_ends" in arrays:
+            leases = list(zip(
+                _unpack_keys(arrays["lease_keys"], arrays["lease_ends"]),
+                _unpack_keys(arrays["lease_clients"],
+                             arrays["lease_cends"]),
+                arrays["lease_count"].tolist(),
+                arrays["lease_expire"].tolist()))
     except KeyError as e:
         raise SnapshotError(f"snapshot payload missing array {e}") from None
 
-    return ArenaSnapshot(
+    snap = ArenaSnapshot(
         now=now, layout=layout,
         num_shards=int(meta["num_shards"]),
         capacity_per_shard=int(meta["capacity_per_shard"]),
@@ -367,8 +394,65 @@ def loads(data: bytes) -> ArenaSnapshot:
         planes=planes, gplanes=gplanes, gcfg=gcfg,
         tables=tables, native_tables=native_tables, gtable=gtable,
         gpending=list(meta.get("gpending", ())),
-        warm=warm,
+        warm=warm, leases=leases,
     )
+    _drop_unknown_algorithm_rows(snap)
+    return snap
+
+
+def _drop_unknown_algorithm_rows(snap: ArenaSnapshot) -> int:
+    """Forward-compat restore: rows whose algorithm value is outside the
+    alphabet this build knows (> _MAX_ALGO) were written by a newer version
+    whose packed-column semantics we cannot interpret — e.g. a sliding
+    register decoded as a token balance would serve nonsense.  Those rows
+    log-and-drop to a cold start: expiry is forced to the dead sentinel and
+    their key-table entries are removed, so the keys re-init on first
+    touch.  Returns the number of rows dropped."""
+
+    def _bad_slots(planes):
+        a = np.asarray(planes["algo"])
+        return ((a < 0) | (a > _MAX_ALGO)) & (np.asarray(
+            planes["expire"]) != 0)
+
+    def _prune_table(table, drop):
+        keys, slots, expires = table
+        slots = np.asarray(slots)
+        keep = [j for j, sl in enumerate(slots.tolist()) if sl not in drop]
+        if isinstance(keys, list):
+            kept_keys = [keys[j] for j in keep]
+        else:
+            kept_keys = np.asarray(keys)[keep]
+        return (kept_keys, slots[keep], np.asarray(expires)[keep])
+
+    dropped = 0
+    bad = _bad_slots(snap.planes)
+    if bad.any():
+        dropped += int(bad.sum())
+        snap.planes["expire"] = np.where(bad, 0, snap.planes["expire"])
+        for s in range(bad.shape[0]):
+            drop = set(np.nonzero(bad[s])[0].tolist())
+            if not drop:
+                continue
+            if s < len(snap.tables):
+                snap.tables[s] = _prune_table(snap.tables[s], drop)
+            if s < len(snap.native_tables):
+                snap.native_tables[s] = _prune_table(
+                    snap.native_tables[s], drop)
+    gbad = _bad_slots(snap.gplanes)
+    ga = np.asarray(snap.gcfg["algo"])
+    gbad = gbad | ((ga < 0) | (ga > _MAX_ALGO)) & (
+        np.asarray(snap.gplanes["expire"]) != 0)
+    if gbad.any():
+        dropped += int(gbad.sum())
+        snap.gplanes["expire"] = np.where(gbad, 0, snap.gplanes["expire"])
+        if snap.gtable:
+            snap.gtable = _prune_table(
+                snap.gtable, set(np.nonzero(gbad)[0].tolist()))
+    if dropped:
+        log.warning(
+            "snapshot carries %d rows with unknown algorithm values "
+            "(newer writer?); dropping them to a cold start", dropped)
+    return dropped
 
 
 # ---------------------------------------------------------------- file I/O

@@ -221,21 +221,37 @@ def compare(baseline: dict, fresh_cpu: dict, tolerance: float) -> list[str]:
 
 
 def census_gate(fresh: dict) -> list[str]:
-    """Absolute kernels-per-window budget on the composed serving arm
-    (bench.py records it at the TOP level — box-independent)."""
+    """Absolute kernels-per-window budget on the composed serving arms
+    (bench.py records them at the TOP level — box-independent).  Gates
+    the headline `kernels_per_window` AND every composed_* arm in the
+    per-arm census — including composed_mixed_algos, the window with all
+    five wire algorithms live at once: the algorithm plane must ride the
+    ladder as select-chain depth, never as extra kernels."""
+    checks: dict = {}
     kpw = fresh.get("kernels_per_window")
-    if not isinstance(kpw, (int, float)) or kpw <= 0:
+    if isinstance(kpw, (int, float)) and kpw > 0:
+        checks["kernels_per_window"] = float(kpw)
+    per_arm = fresh.get("census_kernels_per_window")
+    if isinstance(per_arm, dict):
+        for arm in sorted(per_arm):
+            v = per_arm[arm]
+            if (arm.startswith("composed")
+                    and isinstance(v, (int, float)) and v > 0):
+                checks[f"kernels_per_window[{arm}]"] = float(v)
+    if not checks:
         print("  kernels_per_window: absent — census gate skipped")
         return []
-    verdict = "OK" if kpw <= CENSUS_BUDGET_KPW else "REGRESSION"
-    print(f"  kernels_per_window: {kpw:.1f} vs absolute budget "
-          f"{CENSUS_BUDGET_KPW:.1f} (anchor {CENSUS_ANCHOR_KPW:.1f}, "
-          f">= 8x fold) {verdict}")
-    if verdict != "OK":
-        return [f"kernels_per_window: {kpw:.1f} > {CENSUS_BUDGET_KPW:.1f} "
-                "— composed serving ladder regressed past the absolute "
-                "staged budget"]
-    return []
+    failures = []
+    for label, v in checks.items():
+        verdict = "OK" if v <= CENSUS_BUDGET_KPW else "REGRESSION"
+        print(f"  {label}: {v:.1f} vs absolute budget "
+              f"{CENSUS_BUDGET_KPW:.1f} (anchor {CENSUS_ANCHOR_KPW:.1f}, "
+              f">= 8x fold) {verdict}")
+        if verdict != "OK":
+            failures.append(
+                f"{label}: {v:.1f} > {CENSUS_BUDGET_KPW:.1f} — composed "
+                "serving ladder regressed past the absolute staged budget")
+    return failures
 
 
 def extract_measured(fresh: dict) -> dict:

@@ -29,6 +29,9 @@ Arms:
   compact32_xla        one window, compact-word XLA lowering
   fused_window         one window, fused Pallas megakernel
   composed_drain       K=8 composed drain WITH GLOBAL sub-window
+  composed_mixed_algos K=8 composed drain, all 5 wire algorithms live in
+                       one window (same traced program as composed_drain
+                       — the algorithm plane is select depth, not kernels)
   composed_analytics   K=8 composed drain + GLOBAL + analytics reduction
 
 Env: GUBER_PROBE_PLATFORM (cpu for smoke), GUBER_PROBE_JSON=<path> to

@@ -326,6 +326,45 @@ def test_detector_confirms_down_and_rehomes(loop):
     run(loop, body())
 
 
+def test_detector_peer_down_releases_leases(loop):
+    """A confirmed-DOWN peer's concurrency leases are released by the
+    detector's verdict action (core/service.py release_peer_leases):
+    nobody is left on that side to send the releases, and a failing
+    release hook must not block the ring re-home."""
+    async def body():
+        inst = StubRing()
+        released = []
+
+        async def release(host):
+            released.append(host)
+            if host == "peer:3":
+                raise RuntimeError("book unavailable")
+            return 3
+
+        inst.release_peer_leases = release
+        ok = {"peer:2": True, "peer:3": True}
+        mon, _ = _monitor(inst, ["self:1", "peer:2", "peer:3"], ok,
+                          suspect_after=2)
+        await mon.probe_once()
+        ok["peer:2"] = False
+        await mon.probe_once()
+        await mon.probe_once()
+        assert mon.snapshot()["peers"]["peer:2"]["state"] == DOWN
+        assert released == ["peer:2"]
+        assert inst.rehomes == [(("peer:3", "self:1"), "down")]
+
+        # the second peer's release raises — the verdict path (breaker,
+        # re-home) must complete anyway
+        ok["peer:3"] = False
+        await mon.probe_once()
+        await mon.probe_once()
+        assert mon.snapshot()["peers"]["peer:3"]["state"] == DOWN
+        assert released == ["peer:2", "peer:3"]
+        assert inst.rehomes[-1] == (("self:1",), "down")
+
+    run(loop, body())
+
+
 def test_detector_flap_hysteresis_never_churns_ring(loop):
     """A peer failing every other probe never accumulates suspect_after
     CONSECUTIVE misses — the ring must not re-home once."""

@@ -54,7 +54,8 @@ from gubernator_tpu.observability.metrics import Metrics
 pytestmark = pytest.mark.devprof
 
 CENSUS_CLASSES = ("int64_xla", "compact32_xla", "fused_window",
-                  "composed_drain", "composed_analytics")
+                  "composed_drain", "composed_mixed_algos",
+                  "composed_analytics")
 
 
 # --------------------------------------------------------------- trace parsing

@@ -48,9 +48,12 @@ from gubernator_tpu.ops import pallas_kernel as pk
 T0 = 1_754_000_000_000
 
 
-def _adversarial_state(rng, C, now):
+def _adversarial_state(rng, C, now, algo_hi=2):
     """Arena rows inside the compact caps, with deliberate leaky-invariant
-    violations (remaining > limit) and times straddling now."""
+    violations (remaining > limit) and times straddling now.  algo_hi=5
+    seeds rows under every wire algorithm (GCRA TAT times, sliding packed
+    two-bucket remainders, concurrency free-slot counters) — any int is a
+    structurally valid stored value for each ladder."""
     limit = rng.integers(1, 900, C).astype(np.int64)
     remaining = rng.integers(0, 1000, C).astype(np.int64)  # may exceed limit
     return kernel.BucketState(
@@ -59,11 +62,11 @@ def _adversarial_state(rng, C, now):
         remaining=jnp.asarray(remaining),
         tstamp=jnp.asarray(now + rng.integers(-400_000, 400_000, C)),
         expire=jnp.asarray(now + rng.integers(-400_000, 400_000, C)),
-        algo=jnp.asarray(rng.integers(0, 2, C), jnp.int32),
+        algo=jnp.asarray(rng.integers(0, algo_hi, C), jnp.int32),
     )
 
 
-def _adversarial_batch(rng, B, C):
+def _adversarial_batch(rng, B, C, algo_hi=2):
     slot = rng.integers(0, C, B).astype(np.int32)
     hot = rng.integers(0, C, 3)
     dup = rng.random(B) < 0.7
@@ -81,12 +84,20 @@ def _adversarial_batch(rng, B, C):
     duration = np.full(B, int(rng.integers(1_000, 90_000)), np.int64)
     dflip = rng.random(B) < 0.2
     duration[dflip] = rng.integers(1_000, 500_000, int(dflip.sum()))
-    algo = np.full(B, int(rng.integers(0, 2)), np.int32)
+    algo = np.full(B, int(rng.integers(0, algo_hi)), np.int32)
     aflip = rng.random(B) < 0.15
-    algo[aflip] = rng.integers(0, 2, int(aflip.sum())).astype(np.int32)
+    algo[aflip] = rng.integers(0, algo_hi, int(aflip.sum())).astype(np.int32)
+    if algo_hi > kernel.CONCURRENCY:
+        # concurrency releases: negative hits, ONLY on conc lanes (the
+        # compact wire sign-extends hits solely for algo 4)
+        rel = (algo == kernel.CONCURRENCY) & (rng.random(B) < 0.4)
+        hits[rel] = -rng.integers(1, 9, int(rel.sum()))
 
     is_init = (rng.random(B) < 0.1) & (slot >= 0)
-    agg = (rng.random(B) < 0.15) & (slot >= 0) & (hits > 0)
+    # the native router only synthesizes AGG runs for algo <= 1, so AGG
+    # lanes with higher algorithms never reach a window in production
+    agg = ((rng.random(B) < 0.15) & (slot >= 0) & (hits > 0)
+           & (algo <= kernel.LEAKY_BUCKET))
     eslot = np.where(agg, slot | kernel.AGG_SLOT_BIT, slot).astype(np.int32)
     return kernel.WindowBatch(slot=eslot, hits=hits, limit=limit,
                               duration=duration, algo=algo, is_init=is_init)
@@ -152,6 +163,143 @@ def test_fold_adversarial_segments_match_serial(seed):
                 err_msg=f"seed {seed} window {w} compact32 state.{name}")
 
 
+def _run_fold_vs_serial(st0, windows, tag):
+    """Pin fold (window_step) vs the serial single-lane contract vs the
+    compact32-XLA lowering on explicit (batch, now) windows, bit for bit."""
+    st_batch = kernel.BucketState(*[jnp.asarray(np.asarray(a)) for a in st0])
+    st_c32 = kernel.BucketState(*[jnp.asarray(np.asarray(a)) for a in st0])
+    st_serial = kernel.BucketState(*[jnp.asarray(np.asarray(a))
+                                     for a in st0])
+    step = jax.jit(kernel.window_step)
+    step_c32 = jax.jit(pk.window_step_compact32_xla)
+    for w, (batch, now) in enumerate(windows):
+        nj = jnp.int64(now)
+        valid = np.asarray(batch.slot) >= 0
+        st_batch, out = step(st_batch, batch, nj)
+        st_serial, want = _serial_oracle(step, st_serial, batch, nj)
+        for f in kernel.WindowOutput._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(out, f))[valid],
+                np.asarray(getattr(want, f))[valid],
+                err_msg=f"{tag} window {w} out.{f}")
+        for name, a, b in zip(kernel.BucketState._fields,
+                              st_batch, st_serial):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"{tag} window {w} state.{name}")
+        st_c32, out32 = step_c32(st_c32, batch, nj)
+        for f in kernel.WindowOutput._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(out32, f))[valid],
+                np.asarray(getattr(out, f))[valid],
+                err_msg=f"{tag} window {w} compact32 out.{f}")
+        for name, a, b in zip(kernel.BucketState._fields, st_c32, st_batch):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"{tag} window {w} compact32 state.{name}")
+
+
+@pytest.mark.algorithms
+@pytest.mark.parametrize("seed", list(range(8)))
+def test_fold_adversarial_all_algorithms_match_serial(seed):
+    """The 12-seed fuzz above, re-run over the FULL wire algorithm range
+    (token, leaky, GCRA, sliding-window, concurrency) with negative-hits
+    concurrency releases in the mix: segments now flip between all five
+    ladders mid-run, and fold_classify must still either fold exactly or
+    reject to the replay on every lowering."""
+    B, C = 32, 24
+    rng = np.random.default_rng(11_000 + seed)
+    now = T0
+    st0 = _adversarial_state(rng, C, now, algo_hi=5)
+    windows = []
+    for _ in range(4):
+        now += int(rng.integers(1, 300_000))
+        windows.append((_adversarial_batch(rng, B, C, algo_hi=5), now))
+    _run_fold_vs_serial(st0, windows, f"algos seed {seed}")
+
+
+def _one_slot_batch(B, slot, hits, limit, duration, algo, is_init=None):
+    mk = lambda v, dt: np.full(B, v, dt) if np.isscalar(v) \
+        else np.asarray(v, dt)  # noqa: E731
+    return kernel.WindowBatch(
+        slot=mk(slot, np.int32), hits=mk(hits, np.int64),
+        limit=mk(limit, np.int64), duration=mk(duration, np.int64),
+        algo=mk(algo, np.int32),
+        is_init=np.zeros(B, bool) if is_init is None
+        else mk(is_init, bool))
+
+
+def _fresh_state(C):
+    z = jnp.zeros(C, jnp.int64)
+    return kernel.BucketState(limit=z, duration=z, remaining=z,
+                              tstamp=z, expire=z,
+                              algo=jnp.zeros(C, jnp.int32))
+
+
+@pytest.mark.algorithms
+def test_fold_algorithm_switch_mid_stream():
+    """One slot touched under every algorithm value in one run (config
+    flips force the replay) and across windows (each switch re-inits the
+    register): the sequential contract holds bit for bit."""
+    # all four targeted tests share the B=8/C=8 shape so the fold and
+    # compact32 lowerings compile ONCE for the whole group (1-core box)
+    algos = [0, 1, 2, 3, 4, 2, 3, 0]
+    b1 = _one_slot_batch(8, 3, 1, 10, 60_000, algos)
+    b2 = _one_slot_batch(8, 3, 1, 10, 60_000, [4, 4, 0, 4, 1, 2, 3, 0])
+    hits2 = np.asarray(b2.hits).copy()
+    hits2[1] = -1  # a release inside the switch storm
+    b2 = b2._replace(hits=hits2)
+    _run_fold_vs_serial(_fresh_state(8),
+                        [(b1, T0), (b2, T0 + 30_000)], "algo switch")
+
+
+@pytest.mark.algorithms
+def test_fold_concurrency_release_saturates():
+    """Negative-hits releases past the held count: the device counter
+    saturates at limit, over-release never mints free slots."""
+    st = _fresh_state(8)
+    acq = _one_slot_batch(8, 2, [3, 2, 0, 1, 0, 0, 0, 0], 5, 60_000, 4)
+    rel = _one_slot_batch(8, 2, [-10, -1, 2, -4, 0, 0, 0, 0], 5, 60_000, 4)
+    _run_fold_vs_serial(st, [(acq, T0), (rel, T0 + 1_000),
+                             (acq, T0 + 2_000)], "conc release")
+
+
+@pytest.mark.algorithms
+def test_fold_gcra_burst_boundary():
+    """GCRA at the exact emission interval: a full-burst drain followed by
+    touches at TAT-aligned instants (now == stored TAT, one tick before,
+    one after) — the closed-form fold and the replay must agree on the
+    conforming/non-conforming edge."""
+    L, D = 5, 5_000
+    rate = D // L  # 1000ms emission interval
+    st = _fresh_state(8)
+    burst = _one_slot_batch(8, 1, [L, 1, 0, 1, 1, 1, 0, 0], L, D, 2)
+    edge = _one_slot_batch(8, 1, 1, L, D, 2)
+    windows = [(burst, T0),
+               (edge, T0 + rate),          # exactly one interval later
+               (edge, T0 + 2 * rate - 1),  # one tick before the boundary
+               (edge, T0 + 2 * rate),      # exactly on it
+               (edge, T0 + D)]             # TAT horizon
+    _run_fold_vs_serial(st, windows, "gcra boundary")
+
+
+@pytest.mark.algorithms
+def test_fold_sliding_boundary_straddle():
+    """Sliding-window touches straddling the bucket boundary: at window
+    start + D - 1, exactly + D (previous weight hits zero), and + 2D (the
+    previous bucket ages out entirely)."""
+    L, D = 100, 10_000
+    st = _fresh_state(8)
+    fill = _one_slot_batch(8, 0, [60, 0, 30, 0, 0, 0, 0, 0], L, D, 3)
+    touch = _one_slot_batch(8, 0, 1, L, D, 3)
+    windows = [(fill, T0),
+               (touch, T0 + D - 1),
+               (touch, T0 + D),
+               (touch, T0 + 2 * D),
+               (fill, T0 + 3 * D + 1)]
+    _run_fold_vs_serial(st, windows, "sliding straddle")
+
+
 def _has_replay_shape(batch):
     """True iff some duplicate run carries distinct nonzero hits (an hstar
     violation) or an AGG lane inside a multi-lane run — the shapes
@@ -179,16 +327,34 @@ def test_fused_staging_drain_matches_host_oracle(seed):
     path, on the fold fuzz's adversarial windows (replay-fallback shapes
     guaranteed by construction).  Both layouts pinned: the plane-form grid
     carry and K chained single-window fused calls on the int64 state."""
+    _run_fused_vs_host(np.random.default_rng(9000 + seed), seed, algo_hi=2)
+
+
+@pytest.mark.fused_staging
+@pytest.mark.algorithms
+# two seeds in the per-commit run; the deeper sweep rides the slow lane
+# (tier-1 wall budget on a 1-core box)
+@pytest.mark.parametrize("seed", [0, 1,
+                                  pytest.param(2, marks=pytest.mark.slow),
+                                  pytest.param(3, marks=pytest.mark.slow)])
+def test_fused_staging_drain_all_algorithms(seed):
+    """The fused differential over the full algorithm range: GCRA /
+    sliding / concurrency lanes (negative conc hits sign-extended through
+    the 28-bit compact hits field) through the same packed wire."""
+    _run_fused_vs_host(np.random.default_rng(10_000 + seed), seed,
+                       algo_hi=5)
+
+
+def _run_fused_vs_host(rng, seed, algo_hi):
     K, B, C = 4, 32, 24
-    rng = np.random.default_rng(9000 + seed)
-    st0 = _adversarial_state(rng, C, T0)
+    st0 = _adversarial_state(rng, C, T0, algo_hi)
 
     now = T0
     nows, packs = [], []
     saw_replay = False
     for _ in range(K):
         now += int(rng.integers(1, 300_000))
-        bt = _adversarial_batch(rng, B, C)
+        bt = _adversarial_batch(rng, B, C, algo_hi)
         saw_replay |= _has_replay_shape(bt)
         nows.append(now)
         packs.append(np.asarray(kernel.encode_batch_host(

@@ -341,7 +341,12 @@ def test_composed_window_census_budget():
 
     ANCHOR_KPW = 192.5   # (1257 + 283) / 8: pre-ladder composed window
     BUDGET_KPW = 24      # absolute staged ladder budget (ISSUE 17 bar)
-    XLA_CEILING = 1550   # composed+analytics XLA arm measured 1473
+    # composed+analytics XLA arm: measured 1473 at the PR 16 collapse,
+    # 2463 once the algorithm plane's 5-way select ladders landed (the
+    # GCRA/sliding/concurrency transitions fuse into the SAME launches —
+    # equation growth on the XLA shoulder, zero new kernels on the
+    # staged arms, see BASELINE.md "select depth, not kernels")
+    XLA_CEILING = 2600
 
     eng = _mk_engine()
     conf = AnalyticsConfig()

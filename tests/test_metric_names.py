@@ -70,6 +70,12 @@ NATIVE_NAMES = (
     "guber_tpu_frontdoor_trace_drops",
     # kernel-ladder scoreboard (daemon boot, staged drain)
     "guber_tpu_kernels_per_window",
+    # algorithm plane + concurrency-lease book (algorithms/leases.py)
+    "guber_tpu_decisions_total",
+    "guber_tpu_lease_held_slots",
+    "guber_tpu_lease_clients",
+    "guber_tpu_lease_keys",
+    "guber_tpu_lease_releases_total",
 )
 
 
